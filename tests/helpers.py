@@ -1,0 +1,30 @@
+"""Shared test helpers, modelled on the reference test suite's helpers
+(/root/reference/test/helpers.js) and checkColumns
+(/root/reference/test/new_backend_test.js:7)."""
+from automerge_tpu.columnar import DOC_OPS_COLUMNS, decode_change, encode_change
+
+
+def hash_of(change):
+    return decode_change(encode_change(change))["hash"]
+
+
+def check_columns(opset, expected):
+    """Asserts that the document op columns of `opset` re-encode to exactly
+    the expected bytes (column-name -> list of byte values)."""
+    actual = {}
+    for (name, _cid), (_cid2, buf) in zip(DOC_OPS_COLUMNS, opset._encode_ops_columns()):
+        actual[name] = list(buf)
+    for name, expected_bytes in expected.items():
+        assert actual[name] == expected_bytes, (
+            f"{name} column: got {actual[name]}, expected {expected_bytes}"
+        )
+
+
+def assert_equals_one_of(actual, *candidates):
+    """The CRDT picks an arbitrary-but-consistent winner among conflicts;
+    assert the actual value is one of the permitted outcomes
+    (helpers.js:6-16)."""
+    for candidate in candidates:
+        if actual == candidate:
+            return
+    raise AssertionError(f"{actual!r} is not one of {candidates!r}")
