@@ -1,0 +1,26 @@
+"""UUID generation for actor IDs and table row IDs, with a swappable factory
+for deterministic tests (port of /root/reference/src/uuid.js)."""
+from __future__ import annotations
+
+import uuid as _stdlib_uuid
+
+
+def _default_factory() -> str:
+    return _stdlib_uuid.uuid4().hex
+
+
+_factory = _default_factory
+
+
+def make_uuid() -> str:
+    return _factory()
+
+
+def set_factory(factory) -> None:
+    global _factory
+    _factory = factory
+
+
+def reset_factory() -> None:
+    global _factory
+    _factory = _default_factory

@@ -1,0 +1,315 @@
+"""End-to-end public API tests, ported from the reference suite
+(/root/reference/test/test.js): document lifecycle, concurrent merges,
+conflicts, save/load, history."""
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.uuid import reset_factory, set_factory
+
+from helpers import assert_equals_one_of
+
+
+def set_key(key, value):
+    return lambda d: d.__setitem__(key, value)
+
+
+class TestInit:
+    def test_initially_empty(self):
+        doc = am.init()
+        assert len(doc) == 0
+        assert am.get_object_id(doc) == "_root"
+
+    def test_actor_id_option(self):
+        doc = am.init("0123456789abcdef")
+        assert am.get_actor_id(doc) == "0123456789abcdef"
+
+    def test_rejects_bad_actor_id(self):
+        with pytest.raises(ValueError, match="hex digits"):
+            am.init("not-hex!")
+        with pytest.raises(ValueError, match="even number"):
+            am.init("abc")
+
+    def test_from_data(self):
+        doc = am.from_data({"x": 1, "y": "two"})
+        assert doc["x"] == 1
+        assert doc["y"] == "two"
+        history = am.get_history(doc)
+        assert history[0].change["message"] == "Initialization"
+
+
+class TestChange:
+    def test_change_returns_new_doc(self):
+        d1 = am.init()
+        d2 = am.change(d1, set_key("k", "v"))
+        assert len(d1) == 0
+        assert d2["k"] == "v"
+
+    def test_unchanged_doc_returned_as_is(self):
+        d1 = am.change(am.init(), set_key("k", "v"))
+        d2 = am.change(d1, lambda d: None)
+        assert d2 is d1
+
+    def test_no_op_assignment_not_recorded(self):
+        d1 = am.change(am.init(), set_key("k", "v"))
+        d2 = am.change(d1, set_key("k", "v"))
+        assert d2 is d1
+
+    def test_change_message(self):
+        d1 = am.change(am.init(), "msg here", set_key("k", "v"))
+        assert am.get_history(d1)[0].change["message"] == "msg here"
+
+    def test_nested_maps(self):
+        d1 = am.change(am.init(), set_key("outer", {"inner": {"deep": 42}}))
+        assert d1["outer"]["inner"]["deep"] == 42
+        d2 = am.change(d1, lambda d: d["outer"]["inner"].__setitem__("deep", 43))
+        assert d2["outer"]["inner"]["deep"] == 43
+        assert d1["outer"]["inner"]["deep"] == 42  # immutability
+
+    def test_delete_key(self):
+        d1 = am.change(am.init(), set_key("k", "v"))
+        d2 = am.change(d1, lambda d: d.__delitem__("k"))
+        assert "k" not in d2
+        assert "k" in d1
+
+    def test_read_only_outside_change(self):
+        d1 = am.change(am.init(), set_key("k", "v"))
+        with pytest.raises(TypeError, match="read-only"):
+            d1["k2"] = "v2"
+
+    def test_numbers(self):
+        d1 = am.change(am.init(), lambda d: (
+            d.__setitem__("int", 3),
+            d.__setitem__("float", 1.5),
+            d.__setitem__("uint", am.Uint(7)),
+            d.__setitem__("neg", -12),
+            d.__setitem__("bool", True),
+            d.__setitem__("none", None),
+        ))
+        assert d1["int"] == 3 and isinstance(d1["int"], int)
+        assert d1["float"] == 1.5
+        assert d1["uint"] == 7
+        assert d1["neg"] == -12
+        assert d1["bool"] is True
+        assert d1["none"] is None
+        d2 = am.load(am.save(d1))
+        assert dict(d2) == dict(d1)
+
+    def test_empty_change(self):
+        d1 = am.change(am.init(), set_key("k", "v"))
+        d2 = am.empty_change(d1, "just a milestone")
+        assert dict(d2) == dict(d1)
+        assert am.get_history(d2)[1].change["message"] == "just a milestone"
+
+
+class TestLists:
+    def test_list_operations(self):
+        d1 = am.change(am.init(), set_key("birds", ["chaffinch", "wren"]))
+        assert list(d1["birds"]) == ["chaffinch", "wren"]
+        d2 = am.change(d1, lambda d: d["birds"].append("goldfinch"))
+        d3 = am.change(d2, lambda d: d["birds"].insert(1, "robin"))
+        assert list(d3["birds"]) == ["chaffinch", "robin", "wren", "goldfinch"]
+        d4 = am.change(d3, lambda d: d["birds"].delete_at(0))
+        assert list(d4["birds"]) == ["robin", "wren", "goldfinch"]
+        d5 = am.change(d4, lambda d: d["birds"].__setitem__(1, "jay"))
+        assert list(d5["birds"]) == ["robin", "jay", "goldfinch"]
+
+    def test_list_of_objects(self):
+        d1 = am.change(am.init(), set_key("todos", [{"title": "a", "done": False}]))
+        assert d1["todos"][0]["title"] == "a"
+        d2 = am.change(d1, lambda d: d["todos"][0].__setitem__("done", True))
+        assert d2["todos"][0]["done"] is True
+
+    def test_nested_lists(self):
+        d1 = am.change(am.init(), set_key("matrix", [[1, 2], [3, 4]]))
+        assert list(d1["matrix"][1]) == [3, 4]
+        d2 = am.change(d1, lambda d: d["matrix"][0].append(99))
+        assert list(d2["matrix"][0]) == [1, 2, 99]
+
+    def test_assignment_past_end_pads_with_none(self):
+        d1 = am.change(am.init(), set_key("list", ["a"]))
+        d2 = am.change(d1, lambda d: d["list"].__setitem__(3, "d"))
+        assert list(d2["list"]) == ["a", None, None, "d"]
+
+    def test_element_ids(self):
+        d1 = am.change(am.init("aabbccdd"), set_key("list", ["a", "b"]))
+        elem_ids = am.get_element_ids(d1["list"])
+        assert elem_ids == ["2@aabbccdd", "3@aabbccdd"]
+
+    def test_add_and_remove_same_change(self):
+        d1 = am.change(am.init(), set_key("noodles", []))
+        d1 = am.change(d1, lambda d: (d["noodles"].append("udon"), d["noodles"].delete_at(0)))
+        assert list(d1["noodles"]) == []
+        d1 = am.change(d1, lambda d: (d["noodles"].append("soba"), d["noodles"].delete_at(0)))
+        assert list(d1["noodles"]) == []
+
+
+class TestText:
+    def test_text_editing(self):
+        d1 = am.change(am.init(), set_key("text", am.Text("init")))
+        assert str(d1["text"]) == "init"
+        d2 = am.change(d1, lambda d: d["text"].insert_at(0, "T", "h", "e", " "))
+        assert str(d2["text"]) == "The init"
+        d3 = am.change(d2, lambda d: d["text"].delete_at(4, 4))
+        d4 = am.change(d3, lambda d: d["text"].insert_at(4, "e", "n", "d"))
+        assert str(d4["text"]) == "The end"
+
+    def test_text_set(self):
+        d1 = am.change(am.init(), set_key("text", am.Text("abc")))
+        d2 = am.change(d1, lambda d: d["text"].set(1, "B"))
+        assert str(d2["text"]) == "aBc"
+
+    def test_concurrent_text_insertion_converges(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("text", am.Text("ab")))
+        d2 = am.load(am.save(d1), "bbbbbbbb")
+        d1 = am.change(d1, lambda d: d["text"].insert_at(1, "x"))
+        d2 = am.change(d2, lambda d: d["text"].insert_at(1, "y"))
+        m1 = am.merge(am.clone(d1, "cccccccc"), d2)
+        m2 = am.merge(am.clone(d2, "dddddddd"), d1)
+        assert str(m1["text"]) == str(m2["text"])
+        assert_equals_one_of(str(m1["text"]), "axyb", "ayxb")
+
+
+class TestCounter:
+    def test_counter_in_map(self):
+        d1 = am.change(am.init(), set_key("c", am.Counter(10)))
+        d2 = am.change(d1, lambda d: d["c"].increment())
+        d3 = am.change(d2, lambda d: d["c"].increment(5))
+        d4 = am.change(d3, lambda d: d["c"].decrement(2))
+        assert d4["c"].value == 14
+
+    def test_concurrent_increments_add_up(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("c", am.Counter(0)))
+        d2 = am.load(am.save(d1), "bbbbbbbb")
+        d1 = am.change(d1, lambda d: d["c"].increment(3))
+        d2 = am.change(d2, lambda d: d["c"].increment(4))
+        merged = am.merge(d1, d2)
+        assert merged["c"].value == 7
+
+    def test_cannot_overwrite_counter(self):
+        d1 = am.change(am.init(), set_key("c", am.Counter(0)))
+        with pytest.raises(ValueError, match="Cannot overwrite a Counter"):
+            am.change(d1, set_key("c", 1))
+
+
+class TestTable:
+    def test_table_rows(self):
+        set_factory(iter([f"{i:032x}" for i in range(1, 10)]).__next__)
+        try:
+            d1 = am.change(am.init(), set_key("books", am.Table()))
+            row_id = {}
+
+            def add_row(d):
+                row_id["id"] = d["books"].add({"title": "STP", "author": "MK"})
+
+            d2 = am.change(d1, add_row)
+            book = d2["books"].by_id(row_id["id"])
+            assert book["title"] == "STP"
+            assert book["id"] == row_id["id"]
+            assert d2["books"].count == 1
+            d3 = am.change(d2, lambda d: d["books"].remove(row_id["id"]))
+            assert d3["books"].count == 0
+        finally:
+            reset_factory()
+
+    def test_table_row_update(self):
+        d1 = am.change(am.init(), set_key("books", am.Table()))
+        holder = {}
+
+        def add(d):
+            holder["id"] = d["books"].add({"title": "old"})
+
+        d2 = am.change(d1, add)
+        d3 = am.change(d2, lambda d: d["books"].by_id(holder["id"]).__setitem__("title", "new"))
+        assert d3["books"].by_id(holder["id"])["title"] == "new"
+
+
+class TestMergeAndConflicts:
+    def test_merge_disjoint_keys(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("a", 1))
+        d2 = am.change(am.init("bbbbbbbb"), set_key("b", 2))
+        merged = am.merge(d1, d2)
+        assert merged["a"] == 1 and merged["b"] == 2
+
+    def test_conflict_on_same_key(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("k", "from-a"))
+        d2 = am.change(am.init("bbbbbbbb"), set_key("k", "from-b"))
+        merged = am.merge(d1, d2)
+        # higher actorId wins (Lamport order: same counter, actor tiebreak)
+        assert merged["k"] == "from-b"
+        conflicts = am.get_conflicts(merged, "k")
+        assert set(conflicts.values()) == {"from-a", "from-b"}
+
+    def test_conflict_resolution_is_symmetric(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("k", "from-a"))
+        d2 = am.change(am.init("bbbbbbbb"), set_key("k", "from-b"))
+        m1 = am.merge(am.clone(d1, "11111111"), d2)
+        m2 = am.merge(am.clone(d2, "22222222"), d1)
+        assert m1["k"] == m2["k"]
+
+    def test_concurrent_list_edits_converge(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("l", ["a", "b", "c"]))
+        d2 = am.load(am.save(d1), "bbbbbbbb")
+        d1 = am.change(d1, lambda d: d["l"].insert(1, "x"))
+        d2 = am.change(d2, lambda d: d["l"].delete_at(2))
+        m1 = am.merge(am.clone(d1, "11111111"), d2)
+        m2 = am.merge(am.clone(d2, "22222222"), d1)
+        assert list(m1["l"]) == list(m2["l"])
+        assert list(m1["l"]) == ["a", "x", "b"]
+
+    def test_get_changes_and_apply(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("a", 1))
+        d1_copy = am.load(am.save(d1))
+        d2 = am.change(d1, set_key("b", 2))
+        changes = am.get_changes(d1, d2)
+        assert len(changes) == 1
+        d3, patch = am.apply_changes(d1_copy, changes)
+        assert d3["b"] == 2
+
+
+class TestSaveLoad:
+    def test_round_trip(self):
+        d1 = am.change(am.init("aaaaaaaa"), lambda d: (
+            d.__setitem__("map", {"k": "v"}),
+            d.__setitem__("list", [1, 2, 3]),
+            d.__setitem__("text", am.Text("hi")),
+        ))
+        data = am.save(d1)
+        d2 = am.load(data)
+        assert dict(d2["map"]) == {"k": "v"}
+        assert list(d2["list"]) == [1, 2, 3]
+        assert str(d2["text"]) == "hi"
+
+    def test_save_deterministic(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("x", 1))
+        assert am.save(d1) == am.save(am.load(am.save(d1)))
+
+    def test_clone(self):
+        d1 = am.change(am.init("aaaaaaaa"), set_key("x", 1))
+        d2 = am.clone(d1, "bbbbbbbb")
+        d3 = am.change(d2, set_key("y", 2))
+        assert "y" not in d1
+        assert d3["x"] == 1 and d3["y"] == 2
+
+
+class TestHistory:
+    def test_history_snapshots(self):
+        d1 = am.change(am.init("aaaaaaaa"), "first", set_key("a", 1))
+        d2 = am.change(d1, "second", set_key("b", 2))
+        history = am.get_history(d2)
+        assert len(history) == 2
+        assert [h.change["message"] for h in history] == ["first", "second"]
+        assert dict(history[0].snapshot) == {"a": 1}
+        assert dict(history[1].snapshot) == {"a": 1, "b": 2}
+
+
+class TestObservable:
+    def test_observable_callback(self):
+        observable = am.Observable()
+        d1 = am.init({"actorId": "aaaaaaaa", "observable": observable})
+        d1 = am.change(d1, set_key("list", ["a"]))
+        events = []
+        observable.observe(d1["list"], lambda diff, before, after, local, changes: events.append(
+            (diff["type"], local)
+        ))
+        d2 = am.change(d1, lambda d: d["list"].append("b"))
+        assert events == [("list", True)]
