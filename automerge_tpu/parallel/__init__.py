@@ -1,0 +1,2 @@
+"""Multi-chip execution: document-batch sharding over a jax.sharding.Mesh."""
+from .mesh import make_mesh, shard_batch, sharded_apply_ops, sharded_visible_state

@@ -1,0 +1,81 @@
+"""Mesh construction and sharding for the batched merge engine.
+
+The batch-of-documents axis is embarrassingly parallel (each document's
+state is self-contained, SURVEY.md §2.5), so the primary distribution
+strategy is data parallelism over `dp`. The op-capacity axis can
+additionally be sharded over `sp` (sequence parallelism) for documents with
+very long op logs; XLA inserts the collectives needed by the sort and the
+segmented reductions across `sp` shards.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tpu.engine import BatchedDocState, ChangeOpsBatch, batched_visible_state
+
+
+def make_mesh(devices=None, sp: int = 1) -> Mesh:
+    """Builds a ('dp', 'sp') mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if sp > 1 and n % sp == 0:
+        shape = (n // sp, sp)
+    else:
+        shape = (n, 1)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, ("dp", "sp"))
+
+
+def state_sharding(mesh: Mesh) -> BatchedDocState:
+    row = NamedSharding(mesh, P("dp", "sp"))
+    vec = NamedSharding(mesh, P("dp"))
+    return BatchedDocState(key=row, op=row, action=row, value=row,
+                           pred=row, overwritten=row, num_ops=vec)
+
+
+def changes_sharding(mesh: Mesh) -> ChangeOpsBatch:
+    row = NamedSharding(mesh, P("dp", "sp"))
+    return ChangeOpsBatch(key=row, op=row, action=row, value=row, pred=row)
+
+
+def shard_batch(tree, shardings):
+    """Places a pytree of arrays onto the mesh with the given shardings."""
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def _apply_ops_impl(state: BatchedDocState, changes: ChangeOpsBatch) -> BatchedDocState:
+    # Re-implementation without donation so shardings can be attached by the
+    # caller's jit.
+    from ..tpu.engine import _merge_one_doc
+
+    key, op, action, value, pred, over, num = jax.vmap(_merge_one_doc)(
+        state.key, state.op, state.action, state.value, state.pred,
+        state.overwritten, state.num_ops,
+        changes.key, changes.op, changes.action, changes.value, changes.pred,
+    )
+    return BatchedDocState(key, op, action, value, pred, over, num)
+
+
+def sharded_apply_ops(mesh: Mesh):
+    """Returns a jitted applyChanges step whose inputs/outputs are sharded
+    over the mesh: documents over `dp`, the op axis over `sp`."""
+    s_shard = state_sharding(mesh)
+    c_shard = changes_sharding(mesh)
+    return jax.jit(
+        _apply_ops_impl,
+        in_shardings=(s_shard, c_shard),
+        out_shardings=s_shard,
+    )
+
+
+def sharded_visible_state(mesh: Mesh):
+    s_shard = state_sharding(mesh)
+    out = NamedSharding(mesh, P("dp", "sp"))
+    return jax.jit(
+        batched_visible_state.__wrapped__,
+        in_shardings=(s_shard,),
+        out_shardings=(out, out, out, out),
+    )

@@ -1,0 +1,29 @@
+"""TPU-native batched CRDT merge engine.
+
+The reference engine (backend/new.js) merges one change into one document at
+a time with data-dependent control flow. This package re-architects the hot
+path for TPU execution: documents become fixed-width dense op tensors, and
+applyChanges becomes a batched array program (sort + segmented scans) that
+merges changes into thousands of documents in parallel, vmapped over the doc
+axis and sharded over a jax.sharding.Mesh.
+"""
+import jax
+
+# Packed int64 Lamport opIds require 64-bit array support
+jax.config.update("jax_enable_x64", True)
+
+from .engine import (  # noqa: E402
+    ACTION_DEL,
+    ACTION_INC,
+    ACTION_SET,
+    BatchedDocState,
+    BatchedMapEngine,
+    ChangeOpsBatch,
+    PAD_KEY,
+    batched_apply_ops,
+    batched_visible_state,
+    make_empty_state,
+    pack_opid,
+    unpack_opid,
+)
+from .transcode import BatchTranscoder  # noqa: E402

@@ -1,0 +1,306 @@
+"""Batched merge kernels: the TPU equivalent of the OpSet engine's hot loop.
+
+The reference merge (mergeDocChangeOps, /root/reference/backend/new.js:1052)
+is a sequential two-pointer walk per document. Here the same result is
+computed as a data-parallel array program over a whole batch of documents:
+
+  1. concatenate existing doc ops with incoming change ops
+  2. lexsort rows into the canonical op order: (key, opId counter, opId actor)
+     -- the same total order the columnar engine maintains
+  3. resolve succ/overwrite relationships: an op is overwritten when another
+     (non-increment) op names it in `pred` (matched with a sorted binary
+     search, no scatter loops)
+  4. visibility = zero successors; the winning value per key is the visible
+     op with the greatest Lamport opId (segmented max over the sorted keys);
+     counter increments accumulate onto their target set op instead of
+     hiding it (new.js:937-965)
+
+Everything is static-shape and jit/vmap/shard_map friendly: padded rows carry
+key = PAD_KEY and sort to the end. Map objects and counters are supported in
+this v1 engine (benchmark configs 1 and 3); list/text RGA ordering stays on
+the sequential engine for now (see SURVEY.md §7 step 5).
+
+Lamport opIds are packed into a single int64 as (counter << 20 | actor_num),
+which preserves (counter, actor) ordering for up to 2^20 actors and 2^43 ops.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+PAD_KEY = jnp.iinfo(jnp.int32).max
+ACTOR_BITS = 20
+ACTOR_MASK = (1 << ACTOR_BITS) - 1
+_NEG_INF = jnp.int64(-(2**62))
+
+ACTION_SET = 0
+ACTION_INC = 1
+ACTION_DEL = 2
+
+
+def pack_opid(counter, actor):
+    """Packs (counter, actorNum) into one int64 preserving Lamport order."""
+    counter = jnp.asarray(counter)
+    actor = jnp.asarray(actor)
+    return (counter.astype(jnp.int64) << ACTOR_BITS) | actor.astype(jnp.int64)
+
+
+def unpack_opid(opid):
+    return opid >> ACTOR_BITS, opid & ACTOR_MASK
+
+
+class BatchedDocState(NamedTuple):
+    """Dense op storage for a batch of map documents.
+
+    All row arrays have shape [docs, capacity], sorted by (key, opId);
+    padded slots have key == PAD_KEY and sort last. `overwritten` marks ops
+    with at least one non-increment successor (the dense analogue of
+    succNum > 0); `pred` is the packed opId each op overwrites/increments
+    (-1 if none), from which full succ lists are recovered host-side when
+    transcoding back to the columnar format.
+    """
+
+    key: jax.Array          # int32 interned key id
+    op: jax.Array           # int64 packed opId
+    action: jax.Array       # int32 (ACTION_SET / ACTION_INC / ACTION_DEL)
+    value: jax.Array        # int64 value payload (interned ref or small int)
+    pred: jax.Array         # int64 packed opId, -1 if none
+    overwritten: jax.Array  # bool
+    num_ops: jax.Array      # int32 [docs] live op count
+
+
+class ChangeOpsBatch(NamedTuple):
+    """One batch of incoming change ops per document, shape [docs, m]."""
+
+    key: jax.Array
+    op: jax.Array
+    action: jax.Array
+    value: jax.Array
+    pred: jax.Array
+
+
+def make_empty_state(num_docs: int, capacity: int) -> BatchedDocState:
+    return BatchedDocState(
+        key=jnp.full((num_docs, capacity), PAD_KEY, jnp.int32),
+        op=jnp.zeros((num_docs, capacity), jnp.int64),
+        action=jnp.zeros((num_docs, capacity), jnp.int32),
+        value=jnp.zeros((num_docs, capacity), jnp.int64),
+        pred=jnp.full((num_docs, capacity), -1, jnp.int64),
+        overwritten=jnp.zeros((num_docs, capacity), jnp.bool_),
+        num_ops=jnp.zeros((num_docs,), jnp.int32),
+    )
+
+
+# Merge keys pack (key, opId) into one int64: key in the top 20 bits, the
+# packed opId (counter << 20 | actor) in the low 44. Requires counter < 2^24.
+_MKEY_OP_BITS = 44
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def _merge_key(key, op):
+    return jnp.where(
+        key == PAD_KEY,
+        _I64_MAX,
+        (key.astype(jnp.int64) << _MKEY_OP_BITS) | op,
+    )
+
+
+def _merge_one_doc(s_key, s_op, s_action, s_value, s_pred, s_over, num_ops,
+                   c_key, c_op, c_action, c_value, c_pred):
+    """Merges one document's change ops into its sorted op table (vmapped
+    over the batch).
+
+    The doc state is invariant-sorted by (key, opId), so instead of
+    re-sorting the whole table (the naive O(N log N) per merge), only the
+    small change batch is sorted and merged in by insertion position:
+    searchsorted gives each change op's slot, and every row moves to its
+    final position with one scatter -- O(N) memory traffic + O(M log N)
+    compute, the TPU analogue of the reference's two-pointer merge
+    (mergeDocChangeOps, new.js:1052).
+    """
+    n = s_key.shape[0]
+    m = c_key.shape[0]
+    s_mkey = _merge_key(s_key, s_op)
+
+    # sort the change ops into canonical order
+    c_mkey = _merge_key(c_key, c_op)
+    c_order = jnp.argsort(c_mkey)
+    c_mkey = c_mkey[c_order]
+    c_key = c_key[c_order]
+    c_op = c_op[c_order]
+    c_action = c_action[c_order]
+    c_value = c_value[c_order]
+    c_pred = c_pred[c_order]
+
+    # insertion positions: new row j lands at pos[j] + j. The output is then
+    # built by pure gathers (TPU scatters serialize; gathers vectorise):
+    # output slot t holds new row k-1 if new_pos[k-1] == t, else old row
+    # t - k, where k = |{j : new_pos[j] <= t}|.
+    pos = jnp.searchsorted(s_mkey, c_mkey)
+    new_pos = pos + jnp.arange(m)
+    t = jnp.arange(n)
+    k = jnp.searchsorted(new_pos, t, side="right")
+    is_new = (k > 0) & (new_pos[jnp.maximum(k - 1, 0)] == t)
+    new_idx = jnp.maximum(k - 1, 0)
+    old_idx = jnp.minimum(t - k, n - 1)
+
+    def place(s_arr, c_arr):
+        return jnp.where(is_new, c_arr[new_idx], s_arr[old_idx])
+
+    out_key = place(s_key, c_key)
+    out_op = place(s_op, c_op)
+    out_action = place(s_action, c_action)
+    out_value = place(s_value, c_value)
+    out_pred = place(s_pred, c_pred)
+    out_over = place(s_over, jnp.zeros((m,), jnp.bool_))
+
+    # succ resolution: a non-increment change op overwrites its pred
+    # (increments are successors that keep the counter visible,
+    # new.js:937-965). pred ops share the change op's key, so the target row
+    # is identified exactly by its merge key; membership is a sorted lookup.
+    hides = (c_action != ACTION_INC) & (c_pred >= 0)
+    hide_mkey = jnp.sort(jnp.where(
+        hides,
+        (c_key.astype(jnp.int64) << _MKEY_OP_BITS) | jnp.where(c_pred >= 0, c_pred, 0),
+        _I64_MAX,
+    ))
+    out_mkey = _merge_key(out_key, out_op)
+    p = jnp.minimum(jnp.searchsorted(hide_mkey, out_mkey), m - 1)
+    out_over = out_over | ((hide_mkey[p] == out_mkey) & (out_mkey != _I64_MAX))
+
+    new_num = num_ops + jnp.sum(c_key != PAD_KEY).astype(jnp.int32)
+    return out_key, out_op, out_action, out_value, out_pred, out_over, new_num
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def batched_apply_ops(state: BatchedDocState, changes: ChangeOpsBatch) -> BatchedDocState:
+    """applyChanges over a whole document batch: one fused XLA program,
+    vmapped over the doc axis."""
+    key, op, action, value, pred, over, num = jax.vmap(_merge_one_doc)(
+        state.key, state.op, state.action, state.value, state.pred,
+        state.overwritten, state.num_ops,
+        changes.key, changes.op, changes.action, changes.value, changes.pred,
+    )
+    return BatchedDocState(key, op, action, value, pred, over, num)
+
+
+def _visible_state_one_doc(key, op, action, value, pred, over):
+    """Computes per-row visibility for one document.
+
+    Returns (key, op, winner, value_total): `winner[i]` is true iff row i is
+    the winning visible set op of its key (the visible set op with the
+    greatest Lamport opId -- rows are sorted by (key, opId), so the winner is
+    the last visible set in each key run). `value_total[i]` at a winner row
+    is the winner's value plus the sum of live increments of its key
+    (counter accumulation, new.js:937-965).
+
+    Per-key reductions exploit the sorted key column: run boundaries come
+    from binary search, so segmented sums/maxes reduce to one plain cumsum
+    and one plain cummax -- no scatters (TPU scatters serialise) and no
+    deep scan graphs (compile-time friendly).
+    """
+    n = key.shape[0]
+    is_real = key != PAD_KEY
+    is_set = is_real & (action == ACTION_SET)
+    is_inc = is_real & (action == ACTION_INC)
+    visible_set = is_set & ~over
+
+    # run boundaries of each row's key (key column is sorted)
+    run_start = jnp.searchsorted(key, key, side="left")
+    run_end = jnp.searchsorted(key, key, side="right") - 1
+
+    # winner: the last visible set row of each key run. cummax of visible-set
+    # indices gives the last such row up to any position; evaluate at the
+    # run's end.
+    idx = jnp.arange(n)
+    lv = jax.lax.cummax(jnp.where(visible_set, idx, -1))
+    winner = visible_set & (lv[run_end] == idx)
+
+    # live increments: an inc is live iff its target set op is not
+    # overwritten. The target shares the inc's key, so locate it by merge
+    # key within the sorted rows.
+    mkey = _merge_key(key, op)
+    target_mkey = jnp.where(
+        is_inc & (pred >= 0),
+        (key.astype(jnp.int64) << _MKEY_OP_BITS) | jnp.where(pred >= 0, pred, 0),
+        _I64_MAX,
+    )
+    tpos = jnp.minimum(jnp.searchsorted(mkey, target_mkey), n - 1)
+    target_live = (mkey[tpos] == target_mkey) & ~over[tpos]
+    inc_live = is_inc & target_live
+
+    # per-run increment total via prefix sums evaluated at run boundaries
+    inc_vals = jnp.where(inc_live, value, 0)
+    csum = jnp.concatenate([jnp.zeros((1,), inc_vals.dtype), jnp.cumsum(inc_vals)])
+    inc_total = csum[run_end + 1] - csum[run_start]
+    value_total = jnp.where(winner, value + inc_total, 0)
+    return key, op, winner, value_total
+
+
+@jax.jit
+def batched_visible_state(state: BatchedDocState):
+    """Materialises the visible state of every document: the device-side
+    equivalent of documentPatch (new.js:1604). Returns per-row
+    (key, op, winner, value_total) arrays of shape [docs, capacity]."""
+    return jax.vmap(_visible_state_one_doc)(
+        state.key, state.op, state.action, state.value, state.pred,
+        state.overwritten,
+    )
+
+
+class BatchedMapEngine:
+    """Host-side driver for the batched map/counter engine.
+
+    Maintains the dense device state for a batch of documents. The capacity
+    doubles when a merge would overflow, bucketing shapes by powers of two so
+    recompiles are amortised.
+    """
+
+    def __init__(self, num_docs: int, capacity: int = 1024):
+        self.num_docs = num_docs
+        self.capacity = capacity
+        self.state = make_empty_state(num_docs, capacity)
+
+    def apply_batch(self, changes: ChangeOpsBatch) -> BatchedDocState:
+        needed = int(jnp.max(self.state.num_ops)) + changes.key.shape[1]
+        while needed > self.capacity:
+            self.capacity *= 2
+            self.state = _grow_state(self.state, self.capacity)
+        self.state = batched_apply_ops(self.state, changes)
+        return self.state
+
+    def visible_state(self):
+        return batched_visible_state(self.state)
+
+
+def _grow_state(state: BatchedDocState, capacity: int) -> BatchedDocState:
+    num_docs, old_cap = state.key.shape
+    pad = capacity - old_cap
+
+    def grow(arr, fill):
+        return jnp.concatenate(
+            [arr, jnp.full((num_docs, pad), fill, arr.dtype)], axis=1
+        )
+
+    return BatchedDocState(
+        key=grow(state.key, PAD_KEY),
+        op=grow(state.op, 0),
+        action=grow(state.action, 0),
+        value=grow(state.value, 0),
+        pred=grow(state.pred, -1),
+        overwritten=grow(state.overwritten, False),
+        num_ops=state.num_ops,
+    )
+
+
+def changes_from_numpy(keys, ops, actions, values, preds) -> ChangeOpsBatch:
+    return ChangeOpsBatch(
+        key=jnp.asarray(keys, jnp.int32),
+        op=jnp.asarray(ops, jnp.int64),
+        action=jnp.asarray(actions, jnp.int32),
+        value=jnp.asarray(values, jnp.int64),
+        pred=jnp.asarray(preds, jnp.int64),
+    )

@@ -1,0 +1,109 @@
+"""Host-side transcoding between Automerge change ops and dense op tensors.
+
+The variable-length columnar encodings (LEB128/RLE, backend/encoding.js) are
+hostile to fixed-width SIMD, so the TPU engine works on dense interned
+tensors: actors, keys and values are interned into per-batch tables on the
+host, and ops become int32/int64 rows (SURVEY.md §7 'Architecture mapping').
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .engine import (
+    ACTION_DEL,
+    ACTION_INC,
+    ACTION_SET,
+    PAD_KEY,
+    ChangeOpsBatch,
+    changes_from_numpy,
+)
+from ..common import parse_op_id
+
+_COUNTER_TAG = object()
+
+
+class _Interner:
+    def __init__(self):
+        self.table = []
+        self.index = {}
+
+    def intern(self, value) -> int:
+        key = value if isinstance(value, (str, int, float, bool, bytes, type(None))) else id(value)
+        idx = self.index.get(key)
+        if idx is None:
+            idx = len(self.table)
+            self.table.append(value)
+            self.index[key] = idx
+        return idx
+
+    def lookup(self, idx: int):
+        return self.table[idx]
+
+
+class BatchTranscoder:
+    """Interns actors/keys/values for one document batch and packs change ops
+    into ChangeOpsBatch tensors."""
+
+    def __init__(self):
+        self.actors = _Interner()
+        self.keys = _Interner()
+        self.values = _Interner()
+
+    def pack_opid_str(self, op_id: str) -> int:
+        p = parse_op_id(op_id)
+        return (p.counter << 20) | self.actors.intern(p.actor_id)
+
+    def op_row(self, op: dict, op_counter: int, actor: str):
+        """Converts one root-map change op dict (frontend format) into a dense
+        row (key, op, action, value, pred)."""
+        packed_id = (op_counter << 20) | self.actors.intern(actor)
+        key_id = self.keys.intern(op["key"])
+        pred = self.pack_opid_str(op["pred"][0]) if op.get("pred") else -1
+        action = op["action"]
+        if action == "set":
+            if op.get("datatype") == "counter":
+                return key_id, packed_id, ACTION_SET, int(op["value"]), pred
+            return key_id, packed_id, ACTION_SET, self.values.intern(op.get("value")), pred
+        if action == "inc":
+            return key_id, packed_id, ACTION_INC, int(op["value"]), pred
+        if action == "del":
+            return key_id, packed_id, ACTION_DEL, 0, pred
+        raise ValueError(f"Unsupported op action for the dense engine: {action}")
+
+    def changes_to_batch(self, per_doc_ops, width=None) -> ChangeOpsBatch:
+        """`per_doc_ops` is a list (one entry per document) of lists of
+        (op_dict, op_counter, actor) tuples. Returns a padded ChangeOpsBatch."""
+        num_docs = len(per_doc_ops)
+        m = width or max((len(ops) for ops in per_doc_ops), default=1) or 1
+        keys = np.full((num_docs, m), PAD_KEY, np.int32)
+        ops = np.zeros((num_docs, m), np.int64)
+        actions = np.zeros((num_docs, m), np.int32)
+        values = np.zeros((num_docs, m), np.int64)
+        preds = np.full((num_docs, m), -1, np.int64)
+        for d, doc_ops in enumerate(per_doc_ops):
+            for i, (op, ctr, actor) in enumerate(doc_ops):
+                keys[d, i], ops[d, i], actions[d, i], values[d, i], preds[d, i] = (
+                    self.op_row(op, ctr, actor)
+                )
+        return changes_from_numpy(keys, ops, actions, values, preds)
+
+    def decode_visible(self, keys, ops, winners, values, counter_keys=()):
+        """Converts one document's per-row visibility tensors (from
+        batched_visible_state) back into a Python dict. `counter_keys` is the
+        set of interned key ids whose winning value is a raw counter total
+        rather than an interned ref."""
+        result = {}
+        counter_keys = set(counter_keys)
+        keys = np.asarray(keys)
+        winners = np.asarray(winners)
+        values = np.asarray(values)
+        for i in np.nonzero(winners)[0]:
+            key_id = int(keys[i])
+            if key_id == PAD_KEY:
+                continue
+            key = self.keys.lookup(key_id)
+            if key_id in counter_keys:
+                result[key] = int(values[i])
+            else:
+                result[key] = self.values.lookup(int(values[i]))
+        return result

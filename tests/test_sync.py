@@ -1,0 +1,129 @@
+"""Sync protocol tests, ported from the reference suite
+(/root/reference/test/sync_test.js): two simulated peers exchanging
+messages until convergence."""
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import sync as Sync
+from automerge_tpu.columnar import encode_change
+
+
+def set_key(key, value):
+    return lambda d: d.__setitem__(key, value)
+
+
+def sync_drive(a, b, a_sync_state=None, b_sync_state=None, max_rounds=10):
+    """Message-shuttling driver loop (sync_test.js:15-35)."""
+    a_sync_state = a_sync_state or am.init_sync_state()
+    b_sync_state = b_sync_state or am.init_sync_state()
+    a_to_b = b_to_a = None
+    for _ in range(max_rounds):
+        a_sync_state, a_to_b = am.generate_sync_message(a, a_sync_state)
+        b_sync_state, b_to_a = am.generate_sync_message(b, b_sync_state)
+        if a_to_b is None and b_to_a is None:
+            break
+        if a_to_b is not None:
+            b, b_sync_state, _ = am.receive_sync_message(b, b_sync_state, a_to_b)
+        if b_to_a is not None:
+            a, a_sync_state, _ = am.receive_sync_message(a, a_sync_state, b_to_a)
+    else:
+        raise AssertionError("Did not synchronize within max_rounds")
+    return a, b, a_sync_state, b_sync_state
+
+
+class TestSyncProtocol:
+    def test_empty_docs_converge_quickly(self):
+        a = am.init("aaaaaaaa")
+        b = am.init("bbbbbbbb")
+        a, b, *_ = sync_drive(a, b)
+        assert dict(a) == dict(b) == {}
+
+    def test_one_way_sync(self):
+        a = am.init("aaaaaaaa")
+        b = am.init("bbbbbbbb")
+        for i in range(5):
+            a = am.change(a, set_key("x", i))
+        a, b, *_ = sync_drive(a, b)
+        assert b["x"] == 4
+
+    def test_bidirectional_sync(self):
+        a = am.change(am.init("aaaaaaaa"), set_key("from_a", 1))
+        b = am.change(am.init("bbbbbbbb"), set_key("from_b", 2))
+        a, b, *_ = sync_drive(a, b)
+        assert dict(a) == dict(b) == {"from_a": 1, "from_b": 2}
+
+    def test_incremental_sync_after_initial(self):
+        a = am.change(am.init("aaaaaaaa"), set_key("x", 1))
+        b = am.init("bbbbbbbb")
+        a, b, sa, sb = sync_drive(a, b)
+        a = am.change(a, set_key("y", 2))
+        a, b, sa, sb = sync_drive(a, b, sa, sb)
+        assert dict(b) == {"x": 1, "y": 2}
+
+    def test_concurrent_changes_converge(self):
+        a = am.change(am.init("aaaaaaaa"), set_key("base", 0))
+        b = am.init("bbbbbbbb")
+        a, b, sa, sb = sync_drive(a, b)
+        a = am.change(a, set_key("a_key", "a"))
+        b = am.change(b, set_key("b_key", "b"))
+        a, b, sa, sb = sync_drive(a, b, sa, sb)
+        assert dict(a) == dict(b) == {"base": 0, "a_key": "a", "b_key": "b"}
+
+    def test_sync_message_round_trip(self):
+        msg = {
+            "heads": [],
+            "need": [],
+            "have": [{"lastSync": [], "bloom": b""}],
+            "changes": [b"fake-change-bytes"],
+        }
+        assert Sync.decode_sync_message(Sync.encode_sync_message(msg)) == msg
+
+    def test_sync_state_round_trip(self):
+        a = am.change(am.init("aaaaaaaa"), set_key("x", 1))
+        b = am.init("bbbbbbbb")
+        a, b, sa, sb = sync_drive(a, b)
+        encoded = Sync.encode_sync_state(sa)
+        decoded = Sync.decode_sync_state(encoded)
+        assert decoded["sharedHeads"] == sa["sharedHeads"]
+        assert decoded["lastSentHeads"] == []
+
+    def test_peer_reset_triggers_full_resync(self):
+        a = am.change(am.init("aaaaaaaa"), set_key("x", 1))
+        b = am.init("bbbbbbbb")
+        a, b, sa, sb = sync_drive(a, b)
+        # b loses all state; fresh doc and sync state
+        b2 = am.init("cccccccc")
+        a, b2, sa2, sb2 = sync_drive(a, b2, am.init_sync_state(), am.init_sync_state())
+        assert dict(b2) == {"x": 1}
+
+
+class TestBloomFilter:
+    def test_contains_added_hashes(self):
+        hashes = [("%02x" % i) * 32 for i in range(10)]
+        bloom = Sync.BloomFilter(hashes)
+        for h in hashes:
+            assert bloom.contains_hash(h)
+
+    def test_serialization_round_trip(self):
+        hashes = [("%02x" % i) * 32 for i in range(10)]
+        bloom = Sync.BloomFilter(hashes)
+        bloom2 = Sync.BloomFilter(bloom.bytes)
+        assert bloom2.num_entries == 10
+        assert bloom2.num_bits_per_entry == 10
+        assert bloom2.num_probes == 7
+        for h in hashes:
+            assert bloom2.contains_hash(h)
+
+    def test_empty_filter(self):
+        bloom = Sync.BloomFilter([])
+        assert bloom.bytes == b""
+        assert not bloom.contains_hash("00" * 32)
+
+    def test_false_positive_rate_reasonable(self):
+        from hashlib import sha256
+
+        hashes = [sha256(str(i).encode()).hexdigest() for i in range(1000)]
+        bloom = Sync.BloomFilter(hashes[:500])
+        false_positives = sum(1 for h in hashes[500:] if bloom.contains_hash(h))
+        assert false_positives <= 15  # ~1% expected rate on 500 probes

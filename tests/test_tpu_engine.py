@@ -1,0 +1,165 @@
+"""Differential tests: the batched TPU engine must produce the same visible
+document state as the sequential reference-parity OpSet engine (the pattern
+of the reference's cross-backend suite, /root/reference/test/wasm.js)."""
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu.tpu as tpu
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.opset import OpSet
+
+
+def opset_visible_map(opset):
+    """Extracts the visible root-map state (and counter totals) from the
+    sequential engine's patch."""
+    patch = opset.get_patch()
+    result = {}
+    for key, values in patch["diffs"]["props"].items():
+        if not values:
+            continue
+        # winner = max Lamport opId (apply_patch.js:33)
+        def lamport(op_id):
+            ctr, actor = op_id.split("@")
+            return (int(ctr), actor)
+
+        winner = max(values.keys(), key=lamport)
+        result[key] = values[winner].get("value")
+    return result
+
+
+def run_differential(num_docs, num_rounds, ops_per_round, seed, with_counters=False):
+    rng = random.Random(seed)
+    actors = ["aaaaaaaa", "bbbbbbbb", "cccccccc"]
+    keys = [f"k{i}" for i in range(8)]
+
+    opsets = [OpSet() for _ in range(num_docs)]
+    engine = tpu.BatchedMapEngine(num_docs, capacity=64)
+    tr = tpu.BatchTranscoder()
+    # per-doc bookkeeping: last op per key -> (opId string, counter?) and seq per actor
+    last_op = [{} for _ in range(num_docs)]
+    seqs = [dict.fromkeys(actors, 0) for _ in range(num_docs)]
+    max_ops = [0] * num_docs
+    counter_keys = [set() for _ in range(num_docs)]
+
+    for _ in range(num_rounds):
+        per_doc_rows = []
+        for d in range(num_docs):
+            actor = rng.choice(actors)
+            seqs[d][actor] += 1
+            start_op = max_ops[d] + 1
+            ops = []
+            for i in range(rng.randrange(1, ops_per_round + 1)):
+                key = rng.choice(keys)
+                prev = last_op[d].get(key)
+                if with_counters and prev and prev[1] == "counter" and rng.random() < 0.5:
+                    op = {"action": "inc", "obj": "_root", "key": key,
+                          "value": rng.randrange(1, 10), "pred": [prev[0]]}
+                elif with_counters and prev is None and rng.random() < 0.3:
+                    op = {"action": "set", "obj": "_root", "key": key, "datatype": "counter",
+                          "value": rng.randrange(100), "pred": []}
+                else:
+                    if prev and prev[1] == "counter":
+                        continue  # counters cannot be overwritten by plain sets here
+                    op = {"action": "set", "obj": "_root", "key": key,
+                          "datatype": "uint", "value": rng.randrange(1000),
+                          "pred": [prev[0]] if prev else []}
+                ops.append(op)
+            # fix op ids and bookkeeping
+            change = {"actor": actor, "seq": seqs[d][actor], "startOp": start_op,
+                      "time": 0, "deps": opsets[d].heads, "ops": ops}
+            rows = []
+            ctr = start_op
+            for op in ops:
+                if op["action"] == "set":
+                    datatype = op.get("datatype")
+                    last_op[d][op["key"]] = (f"{ctr}@{actor}", "counter" if datatype == "counter" else "plain")
+                    if datatype == "counter":
+                        counter_keys[d].add(tr.keys.intern(op["key"]))
+                rows.append((op, ctr, actor))
+                ctr += 1
+            max_ops[d] = ctr - 1
+            opsets[d].apply_changes([encode_change(change)])
+            per_doc_rows.append(rows)
+
+        engine.apply_batch(tr.changes_to_batch(per_doc_rows))
+
+    keys, ops, winners, values = engine.visible_state()
+    for d in range(num_docs):
+        expected = opset_visible_map(opsets[d])
+        actual = tr.decode_visible(
+            keys[d], ops[d], winners[d], values[d], counter_keys[d]
+        )
+        assert actual == expected, f"doc {d}: {actual} != {expected}"
+
+
+class TestBatchedMapEngine:
+    def test_basic_set_and_overwrite(self):
+        engine = tpu.BatchedMapEngine(2, capacity=16)
+        tr = tpu.BatchTranscoder()
+        batch = tr.changes_to_batch([
+            [({"action": "set", "obj": "_root", "key": "x", "value": 1, "pred": []}, 1, "aaaaaaaa"),
+             ({"action": "set", "obj": "_root", "key": "y", "value": 2, "pred": []}, 2, "aaaaaaaa")],
+            [({"action": "set", "obj": "_root", "key": "x", "value": 9, "pred": []}, 1, "bbbbbbbb")],
+        ])
+        engine.apply_batch(batch)
+        batch2 = tr.changes_to_batch([
+            [({"action": "set", "obj": "_root", "key": "x", "value": 5,
+               "pred": ["1@aaaaaaaa"]}, 3, "aaaaaaaa")],
+            [],
+        ])
+        engine.apply_batch(batch2)
+        keys, ops, winners, values = engine.visible_state()
+        doc0 = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        doc1 = tr.decode_visible(keys[1], ops[1], winners[1], values[1])
+        assert doc0 == {"x": 5, "y": 2}
+        assert doc1 == {"x": 9}
+
+    def test_concurrent_conflict_max_opid_wins(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "set", "obj": "_root", "key": "k", "value": "a", "pred": []}, 1, "aaaaaaaa"),
+             ({"action": "set", "obj": "_root", "key": "k", "value": "b", "pred": []}, 1, "bbbbbbbb")],
+        ]))
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        assert doc == {"k": "b"}  # same counter, higher actor wins
+
+    def test_delete(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "set", "obj": "_root", "key": "k", "value": 1, "pred": []}, 1, "aaaaaaaa")],
+        ]))
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "del", "obj": "_root", "key": "k", "pred": ["1@aaaaaaaa"]}, 2, "aaaaaaaa")],
+        ]))
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        assert doc == {}
+
+    def test_counter_increments(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "set", "obj": "_root", "key": "c", "datatype": "counter",
+               "value": 10, "pred": []}, 1, "aaaaaaaa")],
+        ]))
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "inc", "obj": "_root", "key": "c", "value": 3,
+               "pred": ["1@aaaaaaaa"]}, 2, "aaaaaaaa"),
+             ({"action": "inc", "obj": "_root", "key": "c", "value": 4,
+               "pred": ["1@aaaaaaaa"]}, 2, "bbbbbbbb")],
+        ]))
+        ck = {tr.keys.intern("c")}
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0], ck)
+        assert doc == {"c": 17}
+
+    def test_differential_vs_opset(self):
+        run_differential(num_docs=4, num_rounds=6, ops_per_round=4, seed=42)
+
+    def test_differential_with_counters(self):
+        run_differential(num_docs=3, num_rounds=5, ops_per_round=3, seed=7, with_counters=True)
