@@ -1,0 +1,275 @@
+"""L0 codec tests with byte-exact expectations from the reference suite
+(/root/reference/test/encoding_test.js)."""
+import pytest
+
+from automerge_tpu.codecs import (
+    BooleanDecoder,
+    BooleanEncoder,
+    Decoder,
+    DeltaDecoder,
+    DeltaEncoder,
+    Encoder,
+    RLEDecoder,
+    RLEEncoder,
+)
+
+
+def enc_uint(value):
+    e = Encoder()
+    e.append_uint53(value)
+    return list(e.buffer)
+
+
+def enc_int(value):
+    e = Encoder()
+    e.append_int53(value)
+    return list(e.buffer)
+
+
+class TestLEB128:
+    def test_uint_encodings(self):
+        cases = {
+            0: [0], 1: [1], 0x42: [0x42], 0x7F: [0x7F],
+            0x80: [0x80, 0x01], 0xFF: [0xFF, 0x01],
+            0x1234: [0xB4, 0x24], 0x3FFF: [0xFF, 0x7F],
+            0x4000: [0x80, 0x80, 0x01], 0x5678: [0xF8, 0xAC, 0x01],
+            0xFFFFF: [0xFF, 0xFF, 0x3F], 0x1FFFFF: [0xFF, 0xFF, 0x7F],
+            0x200000: [0x80, 0x80, 0x80, 0x01],
+            0xFFFFFFF: [0xFF, 0xFF, 0xFF, 0x7F],
+            0x10000000: [0x80, 0x80, 0x80, 0x80, 0x01],
+            0x7FFFFFFF: [0xFF, 0xFF, 0xFF, 0xFF, 0x07],
+            0x87654321: [0xA1, 0x86, 0x95, 0xBB, 0x08],
+            0xFFFFFFFF: [0xFF, 0xFF, 0xFF, 0xFF, 0x0F],
+        }
+        for value, expected in cases.items():
+            assert enc_uint(value) == expected, hex(value)
+            d = Decoder(bytes(expected))
+            assert d.read_uint53() == value
+            assert d.done
+
+    def test_int_encodings(self):
+        cases = {
+            0: [0], 1: [1], -1: [0x7F],
+            0x3F: [0x3F], 0x40: [0xC0, 0x00],
+            -0x3F: [0x41], -0x40: [0x40], -0x41: [0xBF, 0x7F],
+            0x1FFF: [0xFF, 0x3F], 0x2000: [0x80, 0xC0, 0x00],
+            -0x2000: [0x80, 0x40], -0x2001: [0xFF, 0xBF, 0x7F],
+            0xFFFFF: [0xFF, 0xFF, 0x3F], 0x100000: [0x80, 0x80, 0xC0, 0x00],
+            -0x100000: [0x80, 0x80, 0x40], -0x100001: [0xFF, 0xFF, 0xBF, 0x7F],
+        }
+        for value, expected in cases.items():
+            assert enc_int(value) == expected, hex(value)
+            d = Decoder(bytes(expected))
+            assert d.read_int53() == value
+            assert d.done
+
+    def test_uint53_bounds(self):
+        enc_uint(2**53 - 1)  # max safe
+        with pytest.raises(ValueError):
+            enc_uint(2**53)
+        with pytest.raises(ValueError):
+            enc_uint(-1)
+
+    def test_int53_bounds(self):
+        enc_int(2**53 - 1)
+        enc_int(-(2**53 - 1))
+        with pytest.raises(ValueError):
+            enc_int(2**53)
+        with pytest.raises(ValueError):
+            enc_int(-(2**53))
+
+    def test_uint32_range_check(self):
+        e = Encoder()
+        e.append_uint32(0xFFFFFFFF)
+        with pytest.raises(ValueError):
+            Encoder().append_uint32(0x100000000)
+
+    def test_incomplete_number(self):
+        with pytest.raises(ValueError, match="incomplete number"):
+            Decoder(bytes([0x80])).read_uint53()
+
+    def test_prefixed_strings(self):
+        e = Encoder()
+        e.append_prefixed_string("hello")
+        assert list(e.buffer) == [5, 0x68, 0x65, 0x6C, 0x6C, 0x6F]
+        d = Decoder(e.buffer)
+        assert d.read_prefixed_string() == "hello"
+
+    def test_utf8_multibyte(self):
+        e = Encoder()
+        e.append_prefixed_string("çäö")
+        d = Decoder(e.buffer)
+        assert d.read_prefixed_string() == "çäö"
+
+
+class TestRLE:
+    def rle(self, type_, values):
+        e = RLEEncoder(type_)
+        for v in values:
+            e.append_value(v)
+        return e.buffer
+
+    def test_repetition_run(self):
+        # 5x the same value: repetition record (count, value)
+        assert list(self.rle("uint", [7, 7, 7, 7, 7])) == [5, 7]
+
+    def test_literal_run(self):
+        # distinct values: literal record (-count, values...)
+        assert list(self.rle("uint", [1, 2, 3])) == [0x7D, 1, 2, 3]
+
+    def test_null_runs(self):
+        assert list(self.rle("uint", [None, None, None, 4])) == [0, 3, 0x7F, 4]
+
+    def test_only_nulls_encodes_empty(self):
+        assert self.rle("uint", [None, None]) == b""
+
+    def test_trailing_nulls_after_values_kept(self):
+        assert list(self.rle("uint", [1, None, None])) == [0x7F, 1, 0, 2]
+
+    def test_mixed_runs(self):
+        values = [1, 1, 1, 2, 3, 3, 3]
+        assert list(self.rle("uint", values)) == [3, 1, 0x7F, 2, 3, 3]
+
+    def test_round_trip(self):
+        values = [1, 1, 1, None, None, 2, 3, 4, 4, None, 5]
+        d = RLEDecoder("uint", self.rle("uint", values))
+        assert [d.read_value() for _ in values] == values
+        assert d.done
+
+    def test_string_round_trip(self):
+        values = ["a", "a", None, "b", "c", "c"]
+        d = RLEDecoder("utf8", self.rle("utf8", values))
+        assert [d.read_value() for _ in values] == values
+
+    def test_skip_values(self):
+        values = [1, 1, 1, None, None, 2, 3, 4]
+        d = RLEDecoder("uint", self.rle("uint", values))
+        d.skip_values(4)
+        assert [d.read_value() for _ in range(4)] == values[4:]
+
+    def test_append_with_repetitions(self):
+        e = RLEEncoder("uint")
+        e.append_value(3, 4)
+        e.append_value(3, 2)
+        assert list(e.buffer) == [6, 3]
+
+
+class TestDelta:
+    def delta(self, values):
+        e = DeltaEncoder()
+        for v in values:
+            e.append_value(v)
+        return e.buffer
+
+    def test_ascending_run_compresses(self):
+        # 1..5: every delta (including the first, from absolute 0) is 1,
+        # so the whole sequence is one repetition record
+        assert list(self.delta([1, 2, 3, 4, 5])) == [5, 1]
+
+    def test_round_trip(self):
+        values = [10, 15, 13, None, 13, 20]
+        d = DeltaDecoder(self.delta(values))
+        assert [d.read_value() for _ in values] == values
+
+    def test_skip_values(self):
+        values = [3, 4, 5, 6, 10, 2]
+        d = DeltaDecoder(self.delta(values))
+        d.skip_values(3)
+        assert [d.read_value() for _ in range(3)] == values[3:]
+
+
+class TestBoolean:
+    def boolean(self, values):
+        e = BooleanEncoder()
+        for v in values:
+            e.append_value(v)
+        return e.buffer
+
+    def test_alternating_runs(self):
+        # starts with false-count
+        assert list(self.boolean([False, False, True, True, True])) == [2, 3]
+
+    def test_starting_with_true(self):
+        assert list(self.boolean([True, True])) == [0, 2]
+
+    def test_round_trip(self):
+        values = [True, False, False, True, True, True, False]
+        d = BooleanDecoder(self.boolean(values))
+        assert [d.read_value() for _ in values] == values
+        assert d.done
+
+    def test_skip(self):
+        values = [False, False, True, True, False]
+        d = BooleanDecoder(self.boolean(values))
+        d.skip_values(3)
+        assert [d.read_value() for _ in range(2)] == values[3:]
+
+    def test_rejects_non_boolean(self):
+        with pytest.raises(ValueError):
+            BooleanEncoder().append_value(1)
+
+
+class TestColumnarRoundTrips:
+    def test_change_encode_decode(self):
+        from automerge_tpu.columnar import decode_change, encode_change
+
+        change = {
+            "actor": "0123456789abcdef", "seq": 1, "startOp": 1, "time": 12345,
+            "message": "hello", "deps": [], "ops": [
+                {"action": "set", "obj": "_root", "key": "s", "value": "str", "pred": []},
+                {"action": "set", "obj": "_root", "key": "i", "datatype": "int", "value": -7, "pred": []},
+                {"action": "set", "obj": "_root", "key": "u", "datatype": "uint", "value": 7, "pred": []},
+                {"action": "set", "obj": "_root", "key": "f", "datatype": "float64", "value": 1.5, "pred": []},
+                {"action": "set", "obj": "_root", "key": "b", "value": True, "pred": []},
+                {"action": "set", "obj": "_root", "key": "n", "value": None, "pred": []},
+                {"action": "set", "obj": "_root", "key": "t", "datatype": "timestamp", "value": 1700000000000, "pred": []},
+                {"action": "set", "obj": "_root", "key": "c", "datatype": "counter", "value": 5, "pred": []},
+            ],
+        }
+        decoded = decode_change(encode_change(change))
+        for field in ("actor", "seq", "startOp", "time", "message"):
+            assert decoded[field] == change[field]
+        by_key = {op["key"]: op for op in decoded["ops"]}
+        assert by_key["s"]["value"] == "str"
+        assert by_key["i"]["value"] == -7 and by_key["i"]["datatype"] == "int"
+        assert by_key["u"]["value"] == 7 and by_key["u"]["datatype"] == "uint"
+        assert by_key["f"]["value"] == 1.5 and by_key["f"]["datatype"] == "float64"
+        assert by_key["b"]["value"] is True
+        assert by_key["n"]["value"] is None
+        assert by_key["t"]["datatype"] == "timestamp"
+        assert by_key["c"]["datatype"] == "counter"
+
+    def test_large_change_deflates(self):
+        from automerge_tpu.columnar import CHUNK_TYPE_DEFLATE, decode_change, encode_change
+
+        change = {
+            "actor": "aabbccdd", "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+                {"action": "set", "obj": "_root", "key": f"key-{i:04d}", "value": f"val{i}", "pred": []}
+                for i in range(50)
+            ],
+        }
+        encoded = encode_change(change)
+        assert encoded[8] == CHUNK_TYPE_DEFLATE
+        decoded = decode_change(encoded)
+        assert len(decoded["ops"]) == 50
+
+    def test_corrupted_checksum_rejected(self):
+        from automerge_tpu.columnar import decode_change, encode_change
+
+        change = {"actor": "aabbccdd", "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "x", "value": 1, "pred": []},
+        ]}
+        data = bytearray(encode_change(change))
+        data[4] ^= 0xFF  # corrupt checksum
+        with pytest.raises(ValueError, match="checksum does not match"):
+            decode_change(bytes(data))
+
+    def test_split_containers(self):
+        from automerge_tpu.columnar import encode_change, split_containers
+
+        c1 = encode_change({"actor": "aabbccdd", "seq": 1, "startOp": 1, "time": 0,
+                            "deps": [], "ops": []})
+        c2 = encode_change({"actor": "bbccddee", "seq": 1, "startOp": 1, "time": 0,
+                            "deps": [], "ops": []})
+        chunks = split_containers(c1 + c2)
+        assert chunks == [c1, c2]
