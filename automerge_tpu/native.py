@@ -1,0 +1,157 @@
+"""ctypes bindings for the native C++ columnar codecs (native/codecs.cpp).
+
+The native library accelerates the host-side transcoding between the
+variable-length column formats and dense numpy arrays (the input/output of
+the TPU engine). Falls back to the pure-Python codecs when the library has
+not been built; `available()` reports which path is active.
+
+Build with: make -C native   (or python -m automerge_tpu.native --build)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+NULL_SENTINEL = -(2**62)
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                         "native", "libamcodecs.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.am_rle_decode.restype = ctypes.c_int64
+    lib.am_rle_decode.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int,
+                                  ctypes.c_int64, i64p, ctypes.c_size_t]
+    lib.am_rle_encode.restype = ctypes.c_int64
+    lib.am_rle_encode.argtypes = [i64p, ctypes.c_size_t, ctypes.c_int,
+                                  ctypes.c_int64, u8p, ctypes.c_size_t]
+    lib.am_delta_decode.restype = ctypes.c_int64
+    lib.am_delta_decode.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int64,
+                                    i64p, ctypes.c_size_t]
+    lib.am_delta_encode.restype = ctypes.c_int64
+    lib.am_delta_encode.argtypes = [i64p, ctypes.c_size_t, ctypes.c_int64,
+                                    u8p, ctypes.c_size_t]
+    lib.am_bool_decode.restype = ctypes.c_int64
+    lib.am_bool_decode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+    lib.am_bool_encode.restype = ctypes.c_int64
+    lib.am_bool_encode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+    _lib = lib
+    return lib
+
+
+def build(verbose=False):
+    """Compiles the native library with g++."""
+    native_dir = os.path.dirname(_LIB_PATH)
+    result = subprocess.run(["make", "-C", native_dir],
+                            capture_output=not verbose, text=True)
+    if result.returncode != 0:
+        raise RuntimeError(f"native build failed: {result.stderr}")
+    global _lib
+    _lib = None
+    return _load() is not None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _check(rc, what):
+    if rc < 0:
+        raise ValueError(f"native {what} failed with code {rc}")
+    return rc
+
+
+def _as_u8p(buf):
+    return ctypes.cast(ctypes.c_char_p(bytes(buf)), ctypes.POINTER(ctypes.c_uint8))
+
+
+def rle_decode(buf: bytes, signed: bool = False, max_count: int = None) -> np.ndarray:
+    """Decodes an RLE column into an int64 array (nulls = NULL_SENTINEL)."""
+    lib = _load()
+    cap = max_count if max_count is not None else max(16, len(buf) * 64)
+    out = np.empty(cap, np.int64)
+    rc = lib.am_rle_decode(
+        _as_u8p(buf), len(buf), 1 if signed else 0, NULL_SENTINEL,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+    )
+    return out[:_check(rc, "rle_decode")]
+
+
+def rle_encode(values: np.ndarray, signed: bool = False) -> bytes:
+    lib = _load()
+    values = np.ascontiguousarray(values, np.int64)
+    cap = max(16, values.size * 10)
+    out = np.empty(cap, np.uint8)
+    rc = lib.am_rle_encode(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), values.size,
+        1 if signed else 0, NULL_SENTINEL,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    return out[:_check(rc, "rle_encode")].tobytes()
+
+
+def delta_decode(buf: bytes, max_count: int = None) -> np.ndarray:
+    lib = _load()
+    cap = max_count if max_count is not None else max(16, len(buf) * 64)
+    out = np.empty(cap, np.int64)
+    rc = lib.am_delta_decode(
+        _as_u8p(buf), len(buf), NULL_SENTINEL,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+    )
+    return out[:_check(rc, "delta_decode")]
+
+
+def delta_encode(values: np.ndarray) -> bytes:
+    lib = _load()
+    values = np.ascontiguousarray(values, np.int64)
+    cap = max(16, values.size * 10)
+    out = np.empty(cap, np.uint8)
+    rc = lib.am_delta_encode(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), values.size,
+        NULL_SENTINEL,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    return out[:_check(rc, "delta_encode")].tobytes()
+
+
+def bool_decode(buf: bytes, max_count: int = None) -> np.ndarray:
+    lib = _load()
+    cap = max_count if max_count is not None else max(16, len(buf) * 4096)
+    out = np.empty(cap, np.uint8)
+    rc = lib.am_bool_decode(
+        _as_u8p(buf), len(buf),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    return out[:_check(rc, "bool_decode")].astype(bool)
+
+
+def bool_encode(values: np.ndarray) -> bytes:
+    lib = _load()
+    values = np.ascontiguousarray(values, np.uint8)
+    cap = max(16, values.size * 10 + 16)
+    out = np.empty(cap, np.uint8)
+    rc = lib.am_bool_encode(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), values.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
+    )
+    return out[:_check(rc, "bool_encode")].tobytes()
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--build" in sys.argv:
+        ok = build(verbose=True)
+        print("native codecs built" if ok else "build failed")

@@ -1,0 +1,280 @@
+// Native columnar codecs: the host-side transcoding engine.
+//
+// Byte-compatible with the reference column formats
+// (/root/reference/backend/encoding.js): LEB128 varints, RLE columns with
+// repetition/literal/null-run records, Delta columns (RLE over successive
+// differences) and Boolean run-length columns. These are the hot host-side
+// paths when transcoding binary changes/documents into the dense op tensors
+// consumed by the TPU engine, and when re-encoding op tables into the binary
+// document format.
+//
+// Exposed as a C ABI for ctypes binding (no pybind11 in this environment).
+// Null values are represented by a caller-chosen int64 sentinel.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int64_t ERR_TRUNCATED = -1;
+constexpr int64_t ERR_OVERFLOW = -2;
+constexpr int64_t ERR_INVALID = -3;
+
+struct Reader {
+  const uint8_t* buf;
+  size_t len;
+  size_t pos = 0;
+
+  bool done() const { return pos >= len; }
+
+  // Reads an unsigned LEB128 (up to 64 bits). Returns false on truncation.
+  bool read_uleb(uint64_t* out) {
+    uint64_t result = 0;
+    int shift = 0;
+    while (pos < len && shift < 70) {
+      uint8_t byte = buf[pos++];
+      result |= static_cast<uint64_t>(byte & 0x7f) << shift;
+      if (!(byte & 0x80)) {
+        *out = result;
+        return true;
+      }
+      shift += 7;
+    }
+    return false;
+  }
+
+  // Reads a signed LEB128 (up to 64 bits).
+  bool read_sleb(int64_t* out) {
+    uint64_t result = 0;
+    int shift = 0;
+    while (pos < len && shift < 70) {
+      uint8_t byte = buf[pos++];
+      result |= static_cast<uint64_t>(byte & 0x7f) << shift;
+      shift += 7;
+      if (!(byte & 0x80)) {
+        if ((byte & 0x40) && shift < 64) {
+          result |= ~uint64_t{0} << shift;
+        }
+        *out = static_cast<int64_t>(result);
+        return true;
+      }
+    }
+    return false;
+  }
+};
+
+struct Writer {
+  uint8_t* buf;
+  size_t cap;
+  size_t pos = 0;
+
+  bool write_uleb(uint64_t value) {
+    do {
+      if (pos >= cap) return false;
+      uint8_t byte = value & 0x7f;
+      value >>= 7;
+      buf[pos++] = byte | (value ? 0x80 : 0x00);
+    } while (value);
+    return true;
+  }
+
+  bool write_sleb(int64_t value) {
+    while (true) {
+      if (pos >= cap) return false;
+      uint8_t byte = value & 0x7f;
+      value >>= 7;  // arithmetic shift
+      if ((value == 0 && !(byte & 0x40)) || (value == -1 && (byte & 0x40))) {
+        buf[pos++] = byte;
+        return true;
+      }
+      buf[pos++] = byte | 0x80;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- RLE int/uint columns -------------------------------------------------
+
+// Decodes an RLE column of (u)ints into out[0..cap). Nulls become
+// `null_sentinel`. Returns the number of values, or a negative error code.
+int64_t am_rle_decode(const uint8_t* buf, size_t len, int is_signed,
+                      int64_t null_sentinel, int64_t* out, size_t cap) {
+  Reader r{buf, len};
+  size_t n = 0;
+  while (!r.done()) {
+    int64_t count;
+    if (!r.read_sleb(&count)) return ERR_TRUNCATED;
+    if (count > 0) {
+      int64_t value;
+      if (is_signed) {
+        if (!r.read_sleb(&value)) return ERR_TRUNCATED;
+      } else {
+        uint64_t uv;
+        if (!r.read_uleb(&uv)) return ERR_TRUNCATED;
+        value = static_cast<int64_t>(uv);
+      }
+      if (n + count > cap) return ERR_OVERFLOW;
+      for (int64_t i = 0; i < count; i++) out[n++] = value;
+    } else if (count < 0) {
+      for (int64_t i = 0; i < -count; i++) {
+        int64_t value;
+        if (is_signed) {
+          if (!r.read_sleb(&value)) return ERR_TRUNCATED;
+        } else {
+          uint64_t uv;
+          if (!r.read_uleb(&uv)) return ERR_TRUNCATED;
+          value = static_cast<int64_t>(uv);
+        }
+        if (n >= cap) return ERR_OVERFLOW;
+        out[n++] = value;
+      }
+    } else {
+      uint64_t nulls;
+      if (!r.read_uleb(&nulls)) return ERR_TRUNCATED;
+      if (nulls == 0) return ERR_INVALID;
+      if (n + nulls > cap) return ERR_OVERFLOW;
+      for (uint64_t i = 0; i < nulls; i++) out[n++] = null_sentinel;
+    }
+  }
+  return static_cast<int64_t>(n);
+}
+
+// Encodes values[0..n) as an RLE column (reference state machine:
+// repetition / literal / null runs, encoding.js:558). Returns byte length or
+// a negative error code.
+int64_t am_rle_encode(const int64_t* values, size_t n, int is_signed,
+                      int64_t null_sentinel, uint8_t* out, size_t cap) {
+  Writer w{out, cap};
+  size_t i = 0;
+  // Leading all-null column: encodes to nothing only if ALL values are null
+  // (encoding.js finish(): trailing nulls after data are kept)
+  bool wrote_any = false;
+  while (i < n) {
+    if (values[i] == null_sentinel) {
+      size_t j = i;
+      while (j < n && values[j] == null_sentinel) j++;
+      if (j == n && !wrote_any) return static_cast<int64_t>(w.pos);  // skip pure trailing nulls at start
+      if (!w.write_sleb(0) || !w.write_uleb(j - i)) return ERR_OVERFLOW;
+      wrote_any = true;
+      i = j;
+      continue;
+    }
+    // find run of equal values
+    size_t j = i;
+    while (j < n && values[j] == values[i]) j++;
+    size_t run = j - i;
+    if (run >= 2) {
+      if (!w.write_sleb(static_cast<int64_t>(run))) return ERR_OVERFLOW;
+      if (is_signed ? !w.write_sleb(values[i])
+                    : !w.write_uleb(static_cast<uint64_t>(values[i])))
+        return ERR_OVERFLOW;
+      wrote_any = true;
+      i = j;
+    } else {
+      // literal run: values until the next repetition (>=2 equal) or null
+      size_t k = i + 1;
+      while (k < n && values[k] != null_sentinel) {
+        if (k + 1 < n && values[k + 1] == values[k]) break;
+        k++;
+      }
+      size_t lit = k - i;
+      if (!w.write_sleb(-static_cast<int64_t>(lit))) return ERR_OVERFLOW;
+      for (size_t t = i; t < k; t++) {
+        if (is_signed ? !w.write_sleb(values[t])
+                      : !w.write_uleb(static_cast<uint64_t>(values[t])))
+          return ERR_OVERFLOW;
+      }
+      wrote_any = true;
+      i = k;
+    }
+  }
+  return static_cast<int64_t>(w.pos);
+}
+
+// ---- Delta columns --------------------------------------------------------
+
+int64_t am_delta_decode(const uint8_t* buf, size_t len, int64_t null_sentinel,
+                        int64_t* out, size_t cap) {
+  int64_t n = am_rle_decode(buf, len, 1, null_sentinel, out, cap);
+  if (n < 0) return n;
+  int64_t absolute = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (out[i] != null_sentinel) {
+      absolute += out[i];
+      out[i] = absolute;
+    }
+  }
+  return n;
+}
+
+int64_t am_delta_encode(const int64_t* values, size_t n, int64_t null_sentinel,
+                        uint8_t* out, size_t cap) {
+  std::vector<int64_t> deltas(n);
+  int64_t absolute = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (values[i] == null_sentinel) {
+      deltas[i] = null_sentinel;
+    } else {
+      deltas[i] = values[i] - absolute;
+      absolute = values[i];
+    }
+  }
+  return am_rle_encode(deltas.data(), n, 1, null_sentinel, out, cap);
+}
+
+// ---- Boolean columns ------------------------------------------------------
+
+int64_t am_bool_decode(const uint8_t* buf, size_t len, uint8_t* out, size_t cap) {
+  Reader r{buf, len};
+  size_t n = 0;
+  uint8_t value = 1;  // negated before the first run
+  bool first = true;
+  while (!r.done()) {
+    uint64_t count;
+    if (!r.read_uleb(&count)) return ERR_TRUNCATED;
+    value = !value;
+    if (count == 0 && !first) return ERR_INVALID;
+    first = false;
+    if (n + count > cap) return ERR_OVERFLOW;
+    for (uint64_t i = 0; i < count; i++) out[n++] = value;
+  }
+  return static_cast<int64_t>(n);
+}
+
+int64_t am_bool_encode(const uint8_t* values, size_t n, uint8_t* out, size_t cap) {
+  Writer w{out, cap};
+  uint8_t last = 0;  // runs start with false
+  size_t count = 0;
+  for (size_t i = 0; i < n; i++) {
+    uint8_t v = values[i] ? 1 : 0;
+    if (v == last) {
+      count++;
+    } else {
+      if (!w.write_uleb(count)) return ERR_OVERFLOW;
+      last = v;
+      count = 1;
+    }
+  }
+  if (count > 0 && !w.write_uleb(count)) return ERR_OVERFLOW;
+  return static_cast<int64_t>(w.pos);
+}
+
+// ---- LEB128 batch helpers -------------------------------------------------
+
+int64_t am_uleb_decode_batch(const uint8_t* buf, size_t len, int64_t* out, size_t cap) {
+  Reader r{buf, len};
+  size_t n = 0;
+  while (!r.done()) {
+    uint64_t v;
+    if (!r.read_uleb(&v)) return ERR_TRUNCATED;
+    if (n >= cap) return ERR_OVERFLOW;
+    out[n++] = static_cast<int64_t>(v);
+  }
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"
