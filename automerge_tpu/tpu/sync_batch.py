@@ -1,0 +1,164 @@
+"""Batched sync-protocol kernels: Bloom filter construction and querying for
+thousands of (document, peer) pairs on device.
+
+The wire format is unchanged from the single-document protocol
+(automerge_tpu/sync.py, reference backend/sync.js): 10 bits/entry, 7 probes,
+triple hashing from the first 12 bytes of each SHA-256 change hash
+(sync.js:88, Dillinger & Manolios FMCAD 2004). What changes is the execution
+shape: a replica farm syncing B documents against their peers evaluates all
+filters in one batched XLA program instead of B sequential loops.
+
+Filters are padded to a common word capacity; each filter's true bit count
+(`modulo` = 8 * ceil(entries * 10 / 8)) rides along as data, so documents
+with different change counts share one compiled program.
+"""
+from __future__ import annotations
+
+from math import ceil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..codecs import hex_to_bytes
+from ..sync import BITS_PER_ENTRY, NUM_PROBES
+
+WORD_BITS = 32
+
+
+def hash_to_xyz(hash_hex: str) -> tuple[int, int, int]:
+    """First 12 bytes of the hash as three little-endian uint32s."""
+    data = hex_to_bytes(hash_hex)
+    return (
+        int.from_bytes(data[0:4], "little"),
+        int.from_bytes(data[4:8], "little"),
+        int.from_bytes(data[8:12], "little"),
+    )
+
+
+def pack_hashes(hash_lists, width=None):
+    """Packs per-filter hash lists into [B, E, 3] uint32 xyz tensors plus a
+    [B] count vector. Padded entries are zero and masked by the count."""
+    batch = len(hash_lists)
+    width = width or max((len(h) for h in hash_lists), default=1) or 1
+    xyz = np.zeros((batch, width, 3), np.uint32)
+    counts = np.zeros((batch,), np.int32)
+    for b, hashes in enumerate(hash_lists):
+        counts[b] = len(hashes)
+        for e, h in enumerate(hashes):
+            xyz[b, e] = hash_to_xyz(h)
+    return jnp.asarray(xyz), jnp.asarray(counts)
+
+
+def filter_modulo(num_entries):
+    """Bit size of a filter with the given entry count (sync.js:45)."""
+    num_bytes = jnp.ceil(num_entries * BITS_PER_ENTRY / 8).astype(jnp.int32)
+    return 8 * num_bytes
+
+
+def _probe_positions(xyz, modulo):
+    """Probe bit positions for one entry: triple hashing x_{i+1} = x_i + y_i,
+    y_{i+1} = y_i + z (all mod filter size). xyz: [..., 3] uint32."""
+    modulo = jnp.maximum(modulo, 1).astype(jnp.uint32)
+    x = xyz[..., 0] % modulo
+    y = xyz[..., 1] % modulo
+    z = xyz[..., 2] % modulo
+
+    def step(carry, _):
+        x, y = carry
+        nx = (x + y) % modulo
+        ny = (y + z) % modulo
+        return (nx, ny), nx
+
+    (_, _), rest = jax.lax.scan(step, (x, y), None, length=NUM_PROBES - 1)
+    return jnp.concatenate([x[None], rest], axis=0)  # [NUM_PROBES, ...]
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def build_filters(xyz, counts, num_words: int = None):
+    """Builds B Bloom filters at once. xyz: [B, E, 3] uint32; counts: [B].
+    Returns (words [B, W] uint32, modulo [B] int32)."""
+    batch, width, _ = xyz.shape
+    modulo = filter_modulo(counts)
+    if num_words is None:
+        num_words = int(ceil(width * BITS_PER_ENTRY / WORD_BITS)) or 1
+
+    # probe positions for every entry: [P, B, E]
+    probes = _probe_positions(xyz, modulo[:, None].astype(jnp.uint32))
+    entry_mask = (jnp.arange(width)[None, :] < counts[:, None])  # [B, E]
+
+    word_idx = (probes // WORD_BITS).astype(jnp.int32)  # [P, B, E]
+    bit_idx = (probes % WORD_BITS).astype(jnp.uint32)
+
+    # dense OR-accumulation per word: words[b, w] = OR over probes with
+    # word_idx == w (one-hot contraction; no scatters)
+    w_range = jnp.arange(num_words, dtype=jnp.int32)  # [W]
+    hit = (word_idx[..., None] == w_range) & entry_mask[None, :, :, None]  # [P,B,E,W]
+    contrib = jnp.where(hit, (jnp.uint32(1) << bit_idx)[..., None], jnp.uint32(0))
+    words = jax.lax.reduce(
+        contrib, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0, 2)
+    )  # [B, W]
+    return words, modulo
+
+
+@jax.jit
+def query_filters(words, modulo, counts, query_xyz):
+    """Tests C candidate hashes against each of B filters in one shot.
+    query_xyz: [B, C, 3] uint32. Returns contained: [B, C] bool (False for
+    empty filters, matching BloomFilter.containsHash on zero entries)."""
+    probes = _probe_positions(query_xyz, modulo[:, None].astype(jnp.uint32))  # [P, B, C]
+    word_idx = (probes // WORD_BITS).astype(jnp.int32)
+    bit_idx = (probes % WORD_BITS).astype(jnp.uint32)
+    gathered = jnp.take_along_axis(
+        words[None, :, :], jnp.minimum(word_idx, words.shape[1] - 1), axis=2
+    )  # [P, B, C]
+    bit_set = (gathered >> bit_idx) & jnp.uint32(1)
+    contained = jnp.all(bit_set == 1, axis=0)
+    return contained & (counts[:, None] > 0)
+
+
+def filters_to_bytes(words, modulo, counts):
+    """Serialises device filters into the reference wire format
+    (sync.js:68: numEntries, bitsPerEntry, numProbes, bits)."""
+    from ..codecs import Encoder
+
+    words = np.asarray(words)
+    modulo = np.asarray(modulo)
+    counts = np.asarray(counts)
+    out = []
+    for b in range(words.shape[0]):
+        if counts[b] == 0:
+            out.append(b"")
+            continue
+        encoder = Encoder()
+        encoder.append_uint32(int(counts[b]))
+        encoder.append_uint32(BITS_PER_ENTRY)
+        encoder.append_uint32(NUM_PROBES)
+        num_bytes = int(modulo[b]) // 8
+        encoder.append_raw_bytes(words[b].tobytes()[:num_bytes])
+        out.append(encoder.buffer)
+    return out
+
+
+def batched_have_filters(backends, last_syncs):
+    """Host driver: builds the `have` Bloom filters for a batch of documents
+    in one device program (the batched analogue of makeBloomFilter,
+    sync.js:234)."""
+    from .. import backend as Backend
+    from ..columnar import decode_change_meta
+
+    hash_lists = []
+    for backend, last_sync in zip(backends, last_syncs):
+        changes = Backend.get_changes(backend, list(last_sync))
+        hash_lists.append([decode_change_meta(c, True)["hash"] for c in changes])
+    xyz, counts = pack_hashes(hash_lists)
+    num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / WORD_BITS)) or 1
+    words, modulo = build_filters(xyz, counts, num_words)
+    blooms = filters_to_bytes(words, modulo, counts)
+    return [
+        {"lastSync": list(last_sync), "bloom": bloom}
+        for last_sync, bloom in zip(last_syncs, blooms)
+    ]

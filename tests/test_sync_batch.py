@@ -1,0 +1,63 @@
+"""Batched device Bloom filters must be bit-identical to the sequential
+wire-format implementation (sync.py BloomFilter) and interoperate with it."""
+from hashlib import sha256
+from math import ceil
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import sync as Sync
+from automerge_tpu.sync import BITS_PER_ENTRY
+from automerge_tpu.tpu import sync_batch
+
+
+def fake_hashes(tag, n):
+    return [sha256(f"{tag}-{i}".encode()).hexdigest() for i in range(n)]
+
+
+class TestBatchedBloom:
+    def test_bit_identical_to_sequential(self):
+        hash_lists = [fake_hashes("a", 5), fake_hashes("b", 17), [], fake_hashes("c", 1)]
+        xyz, counts = sync_batch.pack_hashes(hash_lists)
+        num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / 32)) or 1
+        words, modulo = sync_batch.build_filters(xyz, counts, num_words)
+        wire = sync_batch.filters_to_bytes(words, modulo, counts)
+        for hashes, bloom_bytes in zip(hash_lists, wire):
+            expected = Sync.BloomFilter(hashes).bytes
+            assert bloom_bytes == expected
+
+    def test_batched_query_matches_sequential(self):
+        hash_lists = [fake_hashes("x", 20), fake_hashes("y", 8)]
+        queries = [fake_hashes("x", 30), fake_hashes("y", 30)]  # half known, half not
+        xyz, counts = sync_batch.pack_hashes(hash_lists)
+        num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / 32)) or 1
+        words, modulo = sync_batch.build_filters(xyz, counts, num_words)
+        q_xyz, _q_counts = sync_batch.pack_hashes(queries)
+        contained = np.asarray(sync_batch.query_filters(words, modulo, counts, q_xyz))
+        for b, (hashes, qs) in enumerate(zip(hash_lists, queries)):
+            bloom = Sync.BloomFilter(hashes)
+            for c, q in enumerate(qs):
+                assert bool(contained[b, c]) == bloom.contains_hash(q), (b, c)
+
+    def test_empty_filter_contains_nothing(self):
+        xyz, counts = sync_batch.pack_hashes([[]])
+        words, modulo = sync_batch.build_filters(xyz, counts, 1)
+        q_xyz, _ = sync_batch.pack_hashes([fake_hashes("q", 3)])
+        contained = np.asarray(sync_batch.query_filters(words, modulo, counts, q_xyz))
+        assert not contained.any()
+
+    def test_batched_have_interoperates_with_protocol(self):
+        """Filters built on device drive the sequential getChangesToSend."""
+        docs = []
+        for i in range(3):
+            doc = am.init(f"{i:08d}" if i else "aaaaaaaa")
+            for j in range(4):
+                doc = am.change(doc, lambda d, j=j: d.__setitem__(f"k{j}", j))
+            docs.append(doc)
+        backends = [am.Frontend.get_backend_state(doc, "test") for doc in docs]
+        haves = sync_batch.batched_have_filters(backends, [[], [], []])
+        for backend, have in zip(backends, haves):
+            # A peer that already has everything: nothing to send
+            to_send = Sync.get_changes_to_send(backend, [have], [])
+            assert to_send == []
