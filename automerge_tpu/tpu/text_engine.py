@@ -1,0 +1,178 @@
+"""Batched Text/list engine: RGA sequences for a batch of documents.
+
+Division of labour (SURVEY.md §7 'Architecture mapping'):
+
+- **Host**: RGA insertion ordering. Each element's document position follows
+  the reference rule "insert after the reference element, skipping concurrent
+  elements with greater opId" (new.js:144-163). The host maintains the
+  element order per document and assigns each element a dense rank; runs of
+  consecutive insertions (typing) are located once per run.
+- **Device**: everything per-element: update/delete visibility (succ
+  marking), conflict resolution (max-opId winner per element), and the
+  visible-text extraction, batched over all documents with the same
+  gather/scan kernels as the map engine (engine.py) using the element rank
+  as the key.
+
+This covers benchmark config 2 (concurrent insert/delete on Text). The rank
+keys are rebuilt per flush; order-maintenance labels (skip lists) are the
+planned upgrade for very long documents.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..common import parse_op_id
+from .engine import (
+    ACTION_DEL,
+    ACTION_SET,
+    BatchedMapEngine,
+    ChangeOpsBatch,
+    PAD_KEY,
+    changes_from_numpy,
+)
+
+
+class _DocOrder:
+    """Host-side RGA order for one document's list object."""
+
+    __slots__ = ("elems", "pos", "dirty")
+
+    def __init__(self):
+        self.elems = []  # elemId strings in document order
+        self.pos = {}  # elemId -> index (lazily rebuilt)
+        self.dirty = False
+
+    def _rebuild(self):
+        if self.dirty:
+            self.pos = {e: i for i, e in enumerate(self.elems)}
+            self.dirty = False
+
+    def insert(self, elem_id: str, ref: str):
+        """Inserts elem_id after `ref` ('_head' for the front), skipping
+        concurrent elements with greater opId (RGA convergence rule)."""
+        self._rebuild()
+        if ref == "_head":
+            index = 0
+        else:
+            index = self.pos[ref] + 1
+        new = parse_op_id(elem_id)
+        while index < len(self.elems):
+            other = parse_op_id(self.elems[index])
+            if (other.counter, other.actor_id) > (new.counter, new.actor_id):
+                index += 1
+            else:
+                break
+        self.elems.insert(index, elem_id)
+        self.dirty = True
+
+    def ranks(self):
+        self._rebuild()
+        return self.pos
+
+
+class BatchedTextEngine:
+    """Driver for a batch of Text documents (one list object per doc)."""
+
+    def __init__(self, num_docs: int, capacity: int = 256):
+        self.num_docs = num_docs
+        self.orders = [_DocOrder() for _ in range(num_docs)]
+        self.engine = BatchedMapEngine(num_docs, capacity)
+        self.values = []  # interned element values
+        self._value_index = {}
+        self.elem_rank = [dict() for _ in range(num_docs)]  # packed elemId -> key used on device
+        self._rank_alloc = [0] * num_docs
+        self.actors = []
+        self._actor_index = {}
+
+    def _actor(self, actor_id):
+        idx = self._actor_index.get(actor_id)
+        if idx is None:
+            idx = len(self.actors)
+            self.actors.append(actor_id)
+            self._actor_index[actor_id] = idx
+        return idx
+
+    def _value(self, v):
+        idx = self._value_index.get(v)
+        if idx is None:
+            idx = len(self.values)
+            self.values.append(v)
+            self._value_index[v] = idx
+        return idx
+
+    def _pack(self, op_id: str) -> int:
+        p = parse_op_id(op_id)
+        return (p.counter << 20) | self._actor(p.actor_id)
+
+    def apply_batch(self, per_doc_ops):
+        """Applies one round of change ops per document. Each op is a tuple
+        (op_dict, op_counter, actor). Supported actions: insert 'set',
+        non-insert 'set' (element overwrite), and 'del'."""
+        rows = []
+        for d, doc_ops in enumerate(per_doc_ops):
+            order = self.orders[d]
+            doc_rows = []
+            for op, ctr, actor in doc_ops:
+                op_id = f"{ctr}@{actor}"
+                packed = (ctr << 20) | self._actor(actor)
+                if op.get("insert"):
+                    ref = op.get("elemId", "_head")
+                    order.insert(op_id, ref)
+                    key = self._rank_alloc[d]
+                    self._rank_alloc[d] += 1
+                    self.elem_rank[d][op_id] = key
+                    doc_rows.append(
+                        (key, packed, ACTION_SET, self._value(op.get("value")), -1)
+                    )
+                elif op["action"] == "set":
+                    elem = op["elemId"]
+                    key = self.elem_rank[d][elem]
+                    pred = self._pack(op["pred"][0]) if op.get("pred") else -1
+                    doc_rows.append(
+                        (key, packed, ACTION_SET, self._value(op.get("value")), pred)
+                    )
+                elif op["action"] == "del":
+                    elem = op["elemId"]
+                    key = self.elem_rank[d][elem]
+                    pred = self._pack(op["pred"][0]) if op.get("pred") else -1
+                    doc_rows.append((key, packed, ACTION_DEL, 0, pred))
+                else:
+                    raise ValueError(f"Unsupported text op: {op['action']}")
+            rows.append(doc_rows)
+
+        width = max((len(r) for r in rows), default=1) or 1
+        keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
+        ops = np.zeros((self.num_docs, width), np.int64)
+        actions = np.zeros((self.num_docs, width), np.int32)
+        values = np.zeros((self.num_docs, width), np.int64)
+        preds = np.full((self.num_docs, width), -1, np.int64)
+        for d, doc_rows in enumerate(rows):
+            for i, (k, o, a, v, p) in enumerate(doc_rows):
+                keys[d, i] = k
+                ops[d, i] = o
+                actions[d, i] = a
+                values[d, i] = v
+                preds[d, i] = p
+        self.engine.apply_batch(changes_from_numpy(keys, ops, actions, values, preds))
+
+    def visible_texts(self):
+        """Extracts each document's visible element values in document order
+        (device visibility + host rank ordering)."""
+        keys, _ops, winners, vals = self.engine.visible_state()
+        keys = np.asarray(keys)
+        winners = np.asarray(winners)
+        vals = np.asarray(vals)
+        texts = []
+        for d in range(self.num_docs):
+            # visible value per rank key
+            by_rank = {}
+            for i in np.nonzero(winners[d])[0]:
+                by_rank[int(keys[d, i])] = self.values[int(vals[d, i])]
+            ranks = self.elem_rank[d]
+            out = []
+            for elem_id in self.orders[d].elems:
+                rank = ranks[elem_id]
+                if rank in by_rank:
+                    out.append(by_rank[rank])
+            texts.append(out)
+        return texts

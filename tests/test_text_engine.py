@@ -1,0 +1,150 @@
+"""Differential tests: the batched Text engine vs the sequential engine on
+concurrent insert/update/delete workloads (benchmark config 2 shape)."""
+import random
+
+import automerge_tpu.tpu.text_engine as te
+from automerge_tpu.columnar import encode_change
+from automerge_tpu.opset import OpSet
+
+
+def opset_visible_text(opset, list_obj):
+    patch = opset.get_patch()
+    prop = patch["diffs"]["props"].get("text", {})
+    for obj_patch in prop.values():
+        if obj_patch.get("objectId") == list_obj:
+            values = []
+            for edit in obj_patch["edits"]:
+                if edit["action"] == "insert":
+                    values.insert(edit["index"], edit["value"].get("value"))
+                elif edit["action"] == "multi-insert":
+                    values[edit["index"]:edit["index"]] = edit["values"]
+                elif edit["action"] == "update":
+                    values[edit["index"]] = edit["value"].get("value")
+                elif edit["action"] == "remove":
+                    del values[edit["index"]:edit["index"] + edit["count"]]
+            return values
+    return []
+
+
+class TestBatchedTextEngine:
+    def test_sequential_typing(self):
+        eng = te.BatchedTextEngine(2, capacity=32)
+        a = "aaaaaaaa"
+        eng.apply_batch([
+            [({"action": "set", "insert": True, "elemId": "_head", "value": "h"}, 1, a),
+             ({"action": "set", "insert": True, "elemId": f"1@{a}", "value": "i"}, 2, a)],
+            [({"action": "set", "insert": True, "elemId": "_head", "value": "x"}, 1, a)],
+        ])
+        assert eng.visible_texts() == [["h", "i"], ["x"]]
+
+    def test_concurrent_inserts_rga_order(self):
+        # two actors insert concurrently after the same element:
+        # higher opId goes first (RGA convergence)
+        eng = te.BatchedTextEngine(1, capacity=32)
+        a, b = "aaaaaaaa", "bbbbbbbb"
+        eng.apply_batch([[({"action": "set", "insert": True, "elemId": "_head", "value": "a"}, 1, a)]])
+        eng.apply_batch([[
+            ({"action": "set", "insert": True, "elemId": f"1@{a}", "value": "x"}, 2, a),
+            ({"action": "set", "insert": True, "elemId": f"1@{a}", "value": "y"}, 2, b),
+        ]])
+        # 2@b > 2@a, so y precedes x
+        assert eng.visible_texts() == [["a", "y", "x"]]
+
+    def test_delete_and_update(self):
+        eng = te.BatchedTextEngine(1, capacity=32)
+        a = "aaaaaaaa"
+        eng.apply_batch([[
+            ({"action": "set", "insert": True, "elemId": "_head", "value": "a"}, 1, a),
+            ({"action": "set", "insert": True, "elemId": f"1@{a}", "value": "b"}, 2, a),
+            ({"action": "set", "insert": True, "elemId": f"2@{a}", "value": "c"}, 3, a),
+        ]])
+        eng.apply_batch([[
+            ({"action": "del", "elemId": f"2@{a}", "pred": [f"2@{a}"]}, 4, a),
+            ({"action": "set", "insert": False, "elemId": f"3@{a}", "value": "C", "pred": [f"3@{a}"]}, 5, a),
+        ]])
+        assert eng.visible_texts() == [["a", "C"]]
+
+    def test_concurrent_delete_vs_update(self):
+        # concurrent delete and update of the same element: update survives
+        eng = te.BatchedTextEngine(1, capacity=32)
+        a, b = "aaaaaaaa", "bbbbbbbb"
+        eng.apply_batch([[({"action": "set", "insert": True, "elemId": "_head", "value": "v"}, 1, a)]])
+        eng.apply_batch([[
+            ({"action": "del", "elemId": f"1@{a}", "pred": [f"1@{a}"]}, 2, a),
+            ({"action": "set", "insert": False, "elemId": f"1@{a}", "value": "V", "pred": [f"1@{a}"]}, 2, b),
+        ]])
+        assert eng.visible_texts() == [["V"]]
+
+    def test_differential_vs_opset(self):
+        rng = random.Random(11)
+        actors = ["aaaaaaaa", "bbbbbbbb"]
+        num_docs = 3
+        opsets = [OpSet() for _ in range(num_docs)]
+        eng = te.BatchedTextEngine(num_docs, capacity=128)
+        list_objs = []
+        views = []
+
+        # bootstrap: each doc gets a text object with one seed element
+        boot_rows = []
+        for d in range(num_docs):
+            a = actors[0]
+            change = {"actor": a, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+                {"action": "makeText", "obj": "_root", "key": "text", "pred": []},
+                {"action": "set", "obj": f"1@{a}", "elemId": "_head", "insert": True,
+                 "value": "s", "pred": []},
+            ]}
+            opsets[d].apply_changes([encode_change(change)])
+            list_objs.append(f"1@{a}")
+            boot_rows.append([
+                ({"action": "set", "insert": True, "elemId": "_head", "value": "s"}, 2, a)
+            ])
+            views.append({
+                "elems": [(f"2@{a}", f"2@{a}")], "deleted": set(),
+                "seqs": {actors[0]: 1, actors[1]: 0}, "max_op": 2,
+            })
+        eng.apply_batch(boot_rows)
+
+        for _round in range(8):
+            per_doc = []
+            for d in range(num_docs):
+                view = views[d]
+                actor = rng.choice(actors)
+                view["seqs"][actor] += 1
+                start = view["max_op"] + 1
+                ctr = start
+                ops = []
+                rows = []
+                for _ in range(rng.randrange(1, 4)):
+                    kind = rng.random()
+                    live = [(e, v) for e, v in view["elems"] if e not in view["deleted"]]
+                    if kind < 0.55 or not live:
+                        ref = rng.choice([e for e, _ in view["elems"]] + ["_head"])
+                        op = {"action": "set", "obj": list_objs[d], "elemId": ref,
+                              "insert": True, "value": f"c{ctr}", "pred": []}
+                        view["elems"].append((f"{ctr}@{actor}", f"{ctr}@{actor}"))
+                    elif kind < 0.8:
+                        elem, val_id = rng.choice(live)
+                        op = {"action": "set", "obj": list_objs[d], "elemId": elem,
+                              "insert": False, "value": f"u{ctr}", "pred": [val_id]}
+                        view["elems"] = [
+                            (e, f"{ctr}@{actor}" if e == elem else v) for e, v in view["elems"]
+                        ]
+                    else:
+                        elem, val_id = rng.choice(live)
+                        op = {"action": "del", "obj": list_objs[d], "elemId": elem,
+                              "insert": False, "pred": [val_id]}
+                        view["deleted"].add(elem)
+                    ops.append(op)
+                    rows.append((dict(op), ctr, actor))
+                    ctr += 1
+                view["max_op"] = ctr - 1
+                change = {"actor": actor, "seq": view["seqs"][actor], "startOp": start,
+                          "time": 0, "deps": opsets[d].heads, "ops": ops}
+                opsets[d].apply_changes([encode_change(change)])
+                per_doc.append(rows)
+            eng.apply_batch(per_doc)
+
+        texts = eng.visible_texts()
+        for d in range(num_docs):
+            expected = opset_visible_text(opsets[d], list_objs[d])
+            assert texts[d] == expected, f"doc {d}: {texts[d]} != {expected}"
