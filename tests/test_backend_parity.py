@@ -1,0 +1,206 @@
+"""Backend facade tests ported from the reference suite
+(/root/reference/test/backend_test.js): exact patch assertions through
+applyChanges/applyLocalChange/getPatch."""
+import pytest
+
+from automerge_tpu import backend as B
+from automerge_tpu.columnar import encode_change
+
+from helpers import hash_of
+
+A1 = "0123456789abcdef"
+A2 = "89abcdef01234567"
+
+
+def apply_one(backend, change):
+    return B.apply_changes(backend, [encode_change(change)])
+
+
+class TestMaps:
+    def test_conflict_on_same_key(self):
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "bird", "value": "magpie", "pred": []}]}
+        c2 = {"actor": A2, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "bird", "value": "blackbird", "pred": []}]}
+        s, _ = apply_one(B.init(), c1)
+        s, patch = apply_one(s, c2)
+        assert patch["diffs"]["props"]["bird"] == {
+            f"1@{A1}": {"type": "value", "value": "magpie"},
+            f"1@{A2}": {"type": "value", "value": "blackbird"},
+        }
+
+    def test_updates_inside_deleted_map(self):
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "makeMap", "obj": "_root", "key": "m", "pred": []},
+            {"action": "set", "obj": f"1@{A1}", "key": "x", "value": 1, "pred": []}]}
+        c2 = {"actor": A1, "seq": 2, "startOp": 3, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "del", "obj": "_root", "key": "m", "pred": [f"1@{A1}"]}]}
+        # concurrent update inside the deleted map
+        c3 = {"actor": A2, "seq": 1, "startOp": 3, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": f"1@{A1}", "key": "x", "value": 2, "pred": [f"2@{A1}"]}]}
+        s, _ = apply_one(B.init(), c1)
+        s, _ = apply_one(s, c2)
+        s, patch = apply_one(s, c3)
+        # the map is deleted; the update produces a patch but the root
+        # contains no 'm' reference (the object is unreachable)
+        final = B.get_patch(s)
+        assert "m" not in final["diffs"]["props"]
+
+    def test_date_at_root(self):
+        now_ms = 1700000000123
+        c = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "now", "value": now_ms,
+             "datatype": "timestamp", "pred": []}]}
+        _s, patch = apply_one(B.init(), c)
+        assert patch == {
+            "clock": {A1: 1}, "deps": [hash_of(c)], "maxOp": 1, "pendingChanges": 0,
+            "diffs": {"objectId": "_root", "type": "map", "props": {
+                "now": {f"1@{A1}": {"type": "value", "value": now_ms, "datatype": "timestamp"}}}},
+        }
+
+
+class TestLists:
+    def test_multi_insert_int(self):
+        c = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "makeList", "obj": "_root", "key": "todos", "pred": []},
+            {"action": "set", "obj": f"1@{A1}", "insert": True, "elemId": "_head",
+             "pred": [], "datatype": "int", "values": [1, 2, 3, 4, 5]}]}
+        _s, patch = apply_one(B.init(), c)
+        assert patch == {
+            "clock": {A1: 1}, "deps": [hash_of(c)], "maxOp": 6, "pendingChanges": 0,
+            "diffs": {"objectId": "_root", "type": "map", "props": {"todos": {f"1@{A1}": {
+                "objectId": f"1@{A1}", "type": "list", "edits": [
+                    {"action": "multi-insert", "index": 0, "elemId": f"2@{A1}",
+                     "datatype": "int", "values": [1, 2, 3, 4, 5]}]}}}},
+        }
+
+    def test_multi_insert_strings_without_datatype(self):
+        c = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []},
+            {"action": "set", "obj": f"1@{A1}", "insert": True, "elemId": "_head",
+             "pred": [], "values": ["a", "b", "c"]}]}
+        _s, patch = apply_one(B.init(), c)
+        edits = patch["diffs"]["props"]["l"][f"1@{A1}"]["edits"]
+        assert edits == [
+            {"action": "multi-insert", "index": 0, "elemId": f"2@{A1}", "values": ["a", "b", "c"]},
+        ]
+
+    def test_update_object_in_list(self):
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []},
+            {"action": "makeMap", "obj": f"1@{A1}", "elemId": "_head", "insert": True, "pred": []},
+            {"action": "set", "obj": f"2@{A1}", "key": "title", "value": "w", "pred": []}]}
+        c2 = {"actor": A1, "seq": 2, "startOp": 4, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": f"2@{A1}", "key": "done", "value": True, "pred": []}]}
+        s, _ = apply_one(B.init(), c1)
+        s, patch = apply_one(s, c2)
+        assert patch["diffs"]["props"]["l"][f"1@{A1}"]["edits"] == [
+            {"action": "update", "index": 0, "opId": f"2@{A1}", "value": {
+                "objectId": f"2@{A1}", "type": "map", "props": {
+                    "done": {f"4@{A1}": {"type": "value", "value": True}}}}},
+        ]
+
+    def test_concurrent_insertion_at_head(self):
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []}]}
+        c2 = {"actor": A1, "seq": 2, "startOp": 2, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": f"1@{A1}", "elemId": "_head", "insert": True,
+             "value": "one", "pred": []}]}
+        c3 = {"actor": A2, "seq": 1, "startOp": 2, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": f"1@{A1}", "elemId": "_head", "insert": True,
+             "value": "two", "pred": []}]}
+        s, _ = apply_one(B.init(), c1)
+        s, _ = apply_one(s, c2)
+        s, patch = apply_one(s, c3)
+        # 2@A2 > 2@A1, so 'two' goes first (index 0)
+        assert patch["diffs"]["props"]["l"][f"1@{A1}"]["edits"] == [
+            {"action": "insert", "index": 0, "elemId": f"2@{A2}", "opId": f"2@{A2}",
+             "value": {"type": "value", "value": "two"}},
+        ]
+
+
+class TestApplyLocalChange:
+    def test_sequence_and_deps(self):
+        s = B.init()
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "a", "value": 1, "pred": []}]}
+        s, p1, b1 = B.apply_local_change(s, c1)
+        c2 = {"actor": A1, "seq": 2, "startOp": 2, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "b", "value": 2, "pred": []}]}
+        s, p2, b2 = B.apply_local_change(s, c2)
+        # the backend adds the local actor's previous hash to deps, and strips
+        # it from the outgoing patch
+        assert p2["deps"] == []
+        from automerge_tpu.columnar import decode_change
+
+        decoded = decode_change(b2)
+        assert decoded["deps"] == [decode_change(b1)["hash"]]
+
+    def test_rejects_replayed_seq(self):
+        s = B.init()
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "a", "value": 1, "pred": []}]}
+        s, _, _ = B.apply_local_change(s, c1)
+        with pytest.raises(ValueError, match="already been applied"):
+            B.apply_local_change(s, dict(c1))
+
+
+class TestChangeGraph:
+    def _two_branches(self):
+        c1 = {"actor": A1, "seq": 1, "startOp": 1, "time": 0, "deps": [], "ops": [
+            {"action": "set", "obj": "_root", "key": "a", "value": 1, "pred": []}]}
+        c2 = {"actor": A1, "seq": 2, "startOp": 2, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": "_root", "key": "b", "value": 2, "pred": []}]}
+        c3 = {"actor": A2, "seq": 1, "startOp": 2, "time": 0, "deps": [hash_of(c1)], "ops": [
+            {"action": "set", "obj": "_root", "key": "c", "value": 3, "pred": []}]}
+        return c1, c2, c3
+
+    def test_get_changes_since_deps(self):
+        c1, c2, c3 = self._two_branches()
+        s = B.init()
+        for c in (c1, c2, c3):
+            s, _ = apply_one(s, c)
+        since_c1 = B.get_changes(s, [hash_of(c1)])
+        assert sorted(len(c) for c in since_c1) == sorted(
+            [len(encode_change(c2)), len(encode_change(c3))]
+        )
+        assert B.get_changes(s, [hash_of(c2), hash_of(c3)]) == []
+
+    def test_get_changes_unknown_hash(self):
+        s, _ = apply_one(B.init(), self._two_branches()[0])
+        with pytest.raises(ValueError, match="hash not found"):
+            B.get_changes(s, ["ab" * 32])
+
+    def test_get_changes_added(self):
+        c1, c2, c3 = self._two_branches()
+        s1 = B.init()
+        s1, _ = apply_one(s1, c1)
+        s2 = B.clone(s1)
+        s1, _ = apply_one(s1, c2)
+        s2, _ = apply_one(s2, c3)
+        added = B.get_changes_added(s1, s2)
+        assert added == [encode_change(c3)]
+
+    def test_get_change_by_hash(self):
+        c1, c2, _ = self._two_branches()
+        s = B.init()
+        s, _ = apply_one(s, c1)
+        s, _ = apply_one(s, c2)
+        assert B.get_change_by_hash(s, hash_of(c1)) == encode_change(c1)
+        assert B.get_change_by_hash(s, "ab" * 32) is None
+
+    def test_heads_after_merge_of_branches(self):
+        c1, c2, c3 = self._two_branches()
+        s = B.init()
+        for c in (c1, c2, c3):
+            s, _ = apply_one(s, c)
+        assert B.get_heads(s) == sorted([hash_of(c2), hash_of(c3)])
+
+    def test_load_changes_then_patch(self):
+        c1, c2, c3 = self._two_branches()
+        s = B.load_changes(B.init(), [encode_change(c) for c in (c1, c2, c3)])
+        patch = B.get_patch(s)
+        props = patch["diffs"]["props"]
+        assert props["a"][f"1@{A1}"]["value"] == 1
+        assert props["b"][f"2@{A1}"]["value"] == 2
+        assert props["c"][f"2@{A2}"]["value"] == 3

@@ -1,0 +1,197 @@
+"""Frontend-layer tests ported from the reference suite
+(/root/reference/test/frontend_test.js, text_test.js, proxies_test.js):
+the frontend driven alone (backend mocked out via the request queue) plus
+document type behaviors."""
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import Frontend
+from automerge_tpu.frontend.datatypes import Text
+
+
+class TestFrontendStandalone:
+    """Frontend without a backend: changes queue as requests
+    (frontend_test.js pattern)."""
+
+    def test_change_produces_request(self):
+        d0 = Frontend.init("aaaaaaaa")  # no backend in options
+        d1, req = Frontend.change(d0, lambda d: d.__setitem__("bird", "magpie"))
+        assert d1["bird"] == "magpie"
+        assert req["actor"] == "aaaaaaaa"
+        assert req["seq"] == 1
+        assert req["ops"] == [
+            {"action": "set", "obj": "_root", "insert": False, "value": "magpie",
+             "pred": [], "key": "bird"},
+        ]
+
+    def test_apply_patch_confirms_request(self):
+        d0 = Frontend.init("aaaaaaaa")
+        d1, req = Frontend.change(d0, lambda d: d.__setitem__("bird", "magpie"))
+        patch = {
+            "actor": "aaaaaaaa", "seq": 1, "maxOp": 1, "clock": {"aaaaaaaa": 1}, "deps": [],
+            "diffs": {"objectId": "_root", "type": "map", "props": {
+                "bird": {"1@aaaaaaaa": {"type": "value", "value": "magpie"}}}},
+        }
+        d2 = Frontend.apply_patch(d1, patch)
+        assert d2["bird"] == "magpie"
+
+    def test_mismatched_seq_rejected(self):
+        d0 = Frontend.init("aaaaaaaa")
+        d1, _req = Frontend.change(d0, lambda d: d.__setitem__("x", 1))
+        bad_patch = {
+            "actor": "aaaaaaaa", "seq": 2, "maxOp": 1, "clock": {"aaaaaaaa": 2}, "deps": [],
+            "diffs": {"objectId": "_root", "type": "map", "props": {}},
+        }
+        with pytest.raises(ValueError, match="Mismatched sequence number"):
+            Frontend.apply_patch(d1, bad_patch)
+
+    def test_remote_patch_rebases_queued_request(self):
+        d0 = Frontend.init("aaaaaaaa")
+        d1, _req = Frontend.change(d0, lambda d: d.__setitem__("mine", 1))
+        remote_patch = {
+            "maxOp": 1, "clock": {"bbbbbbbb": 1}, "deps": [],
+            "diffs": {"objectId": "_root", "type": "map", "props": {
+                "theirs": {"1@bbbbbbbb": {"type": "value", "value": 2}}}},
+        }
+        d2 = Frontend.apply_patch(d1, remote_patch)
+        # while the local change is unconfirmed, the doc keeps showing the
+        # optimistic state; the remote value is held on the rebased base doc
+        assert d2["mine"] == 1
+        assert "theirs" not in d2
+        confirm = {
+            "actor": "aaaaaaaa", "seq": 1, "maxOp": 2,
+            "clock": {"aaaaaaaa": 1, "bbbbbbbb": 1}, "deps": [],
+            "diffs": {"objectId": "_root", "type": "map", "props": {
+                "mine": {"2@aaaaaaaa": {"type": "value", "value": 1}}}},
+        }
+        d3 = Frontend.apply_patch(d2, confirm)
+        assert d3["mine"] == 1
+        assert d3["theirs"] == 2
+
+    def test_defer_actor_id(self):
+        d0 = Frontend.init({"deferActorId": True})
+        assert Frontend.get_actor_id(d0) is None
+        d1 = Frontend.set_actor_id(d0, "ccdd0011")
+        d2, req = Frontend.change(d1, lambda d: d.__setitem__("x", 1))
+        assert req["actor"] == "ccdd0011"
+
+    def test_change_before_actor_id_fails(self):
+        d0 = Frontend.init({"deferActorId": True})
+        with pytest.raises(ValueError, match="Actor ID must be initialized"):
+            Frontend.change(d0, lambda d: d.__setitem__("x", 1))
+
+
+class TestTextType:
+    def test_to_spans(self):
+        d1 = am.change(am.init(), lambda d: d.__setitem__("text", am.Text("ab")))
+        d2 = am.change(d1, lambda d: d["text"].insert_at(2, {"bold": True}))
+        d3 = am.change(d2, lambda d: d["text"].insert_at(3, "c", "d"))
+        spans = d3["text"].to_spans()
+        assert spans[0] == "ab"
+        assert dict(spans[1]) == {"bold": True}
+        assert spans[2] == "cd"
+
+    def test_text_equality_and_str(self):
+        d = am.change(am.init(), lambda d: d.__setitem__("t", am.Text("hello")))
+        assert d["t"] == "hello"
+        assert d["t"] == am.Text("hello")
+        assert str(d["t"]) == "hello"
+        assert len(d["t"]) == 5
+        assert list(d["t"]) == ["h", "e", "l", "l", "o"]
+
+    def test_element_ids(self):
+        d = am.change(am.init("aabbccdd"), lambda d: d.__setitem__("t", am.Text("ab")))
+        assert am.get_element_ids(d["t"]) == ["2@aabbccdd", "3@aabbccdd"]
+
+    def test_objects_in_text(self):
+        d1 = am.change(am.init(), lambda d: d.__setitem__("t", am.Text("ab")))
+        d2 = am.change(d1, lambda d: d["t"].insert_at(1, {"k": "v"}))
+        assert d2["t"][1]["k"] == "v"
+        assert str(d2["t"]) == "ab"  # objects skipped in string form
+
+
+class TestConflictAccessors:
+    def test_map_conflicts(self):
+        d1 = am.change(am.init("aaaaaaaa"), lambda d: d.__setitem__("k", 1))
+        d2 = am.load(am.save(d1), "bbbbbbbb")
+        d1 = am.change(d1, lambda d: d.__setitem__("k", "a-wins"))
+        d2 = am.change(d2, lambda d: d.__setitem__("k", "b-wins"))
+        merged = am.merge(d1, d2)
+        conflicts = am.get_conflicts(merged, "k")
+        assert set(conflicts.values()) == {"a-wins", "b-wins"}
+        assert merged["k"] == "b-wins"
+
+    def test_list_conflicts(self):
+        d1 = am.change(am.init("aaaaaaaa"), lambda d: d.__setitem__("l", ["x"]))
+        d2 = am.load(am.save(d1), "bbbbbbbb")
+        d1 = am.change(d1, lambda d: d["l"].__setitem__(0, "a-val"))
+        d2 = am.change(d2, lambda d: d["l"].__setitem__(0, "b-val"))
+        merged = am.merge(d1, d2)
+        conflicts = am.get_conflicts(merged["l"], 0)
+        assert set(conflicts.values()) == {"a-val", "b-val"}
+
+    def test_no_conflict_returns_none(self):
+        d = am.change(am.init(), lambda d: d.__setitem__("k", 1))
+        assert am.get_conflicts(d, "k") is None
+
+
+class TestProxyBehaviors:
+    def test_map_iteration_and_membership(self):
+        def cb(d):
+            d["a"] = 1
+            d["b"] = 2
+            assert set(d.keys()) == {"a", "b"}
+            assert "a" in d and "z" not in d
+            assert len(d) == 2
+            assert dict(d.items())["b"] == 2
+
+        am.change(am.init(), cb)
+
+    def test_list_methods(self):
+        def cb(d):
+            d["l"] = [1, 2, 3]
+            lst = d["l"]
+            assert lst[0] == 1
+            assert lst[-1] == 3
+            assert list(lst[1:]) == [2, 3]
+            assert 2 in lst
+            assert lst.index(3) == 2
+            lst.extend([4, 5])
+            assert len(lst) == 5
+            assert lst.pop() == 5
+            assert len(lst) == 4
+
+        doc = am.change(am.init(), cb)
+        assert list(doc["l"]) == [1, 2, 3, 4]
+
+    def test_nested_object_identity_error(self):
+        d1 = am.change(am.init(), lambda d: d.__setitem__("a", {"x": 1}))
+
+        def reuse(d):
+            d["b"] = d["a"]
+
+        with pytest.raises(Exception):
+            am.change(d1, reuse)
+
+    def test_get_object_by_id(self):
+        d = am.change(am.init(), lambda d: d.__setitem__("m", {"x": 1}))
+        object_id = am.get_object_id(d["m"])
+        assert am.get_object_by_id(d, object_id) is d["m"]
+
+
+class TestEquals:
+    def test_deep_equality(self):
+        d1 = am.change(am.init("aaaaaaaa"), lambda d: d.update({"a": [1, {"b": 2}]}))
+        d2 = am.change(am.init("bbbbbbbb"), lambda d: d.update({"a": [1, {"b": 2}]}))
+        assert am.equals(d1, d2)
+        d3 = am.change(am.init("cccccccc"), lambda d: d.update({"a": [1, {"b": 3}]}))
+        assert not am.equals(d1, d3)
+
+
+class TestLastLocalChange:
+    def test_returns_binary_change(self):
+        d1 = am.change(am.init("aaaaaaaa"), lambda d: d.__setitem__("x", 1))
+        binary = am.get_last_local_change(d1)
+        decoded = am.decode_change(binary)
+        assert decoded["actor"] == "aaaaaaaa"
+        assert decoded["ops"][0]["key"] == "x"
