@@ -13,7 +13,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..tpu.engine import BatchedDocState, ChangeOpsBatch, batched_visible_state
+from ..tpu.engine import BatchedDocState, ChangeOpsBatch
 
 
 def make_mesh(devices=None, sp: int = 1) -> Mesh:
@@ -71,11 +71,31 @@ def sharded_apply_ops(mesh: Mesh):
     )
 
 
-def sharded_visible_state(mesh: Mesh):
-    s_shard = state_sharding(mesh)
-    out = NamedSharding(mesh, P("dp", "sp"))
-    return jax.jit(
-        batched_visible_state.__wrapped__,
-        in_shardings=(s_shard,),
-        out_shardings=(out, out, out, out),
+def _visible_state_impl(state: BatchedDocState, cmp):
+    from ..tpu.engine import _visible_state_one_doc
+
+    return jax.vmap(_visible_state_one_doc)(
+        state.key, state.op, state.action, state.value, state.pred,
+        state.overwritten, cmp,
     )
+
+
+def sharded_visible_state(mesh: Mesh):
+    """Returns a jitted (state, actor_rank) -> per-row visibility function.
+
+    `actor_rank` (int32[A], replicated) remaps counter-tied conflicts onto
+    lexicographic actor order, matching the engine path's tie-break
+    (engine.batched_visible_state); pass an identity table (arange) to keep
+    intern-order ties.
+    """
+    from ..tpu.engine import remap_opid_actors
+
+    s_shard = state_sharding(mesh)
+    row = NamedSharding(mesh, P("dp", "sp"))
+    rep = NamedSharding(mesh, P())
+    out = (row, row, row, row)
+
+    def impl(state, actor_rank):
+        return _visible_state_impl(state, remap_opid_actors(state.op, actor_rank))
+
+    return jax.jit(impl, in_shardings=(s_shard, rep), out_shardings=out)

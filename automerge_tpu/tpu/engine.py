@@ -52,6 +52,17 @@ def unpack_opid(opid):
     return opid >> ACTOR_BITS, opid & ACTOR_MASK
 
 
+def remap_opid_actors(opid, actor_rank):
+    """Rebuilds packed opIds with the actor index replaced by its
+    lexicographic rank, so int64 comparison == (counter, actorId-string)
+    comparison (the reference's tie-break, new.js:146, apply_patch.js:33)."""
+    actor_rank = jnp.asarray(actor_rank)
+    counter = opid >> ACTOR_BITS
+    actor = (opid & ACTOR_MASK).astype(jnp.int32)
+    rank = actor_rank[jnp.minimum(actor, actor_rank.shape[0] - 1)]
+    return (counter << ACTOR_BITS) | rank.astype(jnp.int64)
+
+
 class BatchedDocState(NamedTuple):
     """Dense op storage for a batch of map documents.
 
@@ -187,20 +198,29 @@ def batched_apply_ops(state: BatchedDocState, changes: ChangeOpsBatch) -> Batche
     return BatchedDocState(key, op, action, value, pred, over, num)
 
 
-def _visible_state_one_doc(key, op, action, value, pred, over):
+def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     """Computes per-row visibility for one document.
 
     Returns (key, op, winner, value_total): `winner[i]` is true iff row i is
     the winning visible set op of its key (the visible set op with the
-    greatest Lamport opId -- rows are sorted by (key, opId), so the winner is
-    the last visible set in each key run). `value_total[i]` at a winner row
-    is the winner's value plus the sum of live increments of its key
-    (counter accumulation, new.js:937-965).
+    greatest Lamport opId, apply_patch.js:33-42). `value_total[i]` at a
+    winner row is the winner's value plus the sum of live increments of its
+    key (counter accumulation, new.js:937-965).
+
+    `cmp` is the comparison opId per row: the packed opId itself, or its
+    actor bits remapped to lexicographic actor ranks (rga.remap_opid_actors)
+    so counter ties break on the actor *string* like the reference
+    (new.js:146, apply_patch.js:33).
 
     Per-key reductions exploit the sorted key column: run boundaries come
     from binary search, so segmented sums/maxes reduce to one plain cumsum
     and one plain cummax -- no scatters (TPU scatters serialise) and no
-    deep scan graphs (compile-time friendly).
+    deep scan graphs (compile-time friendly). The segmented max rides a
+    single global cummax by packing the (ascending) key into the high bits:
+    a later run's rows always dominate earlier runs, so evaluating the
+    prefix max at the run's end yields the run's own max (or an
+    earlier-keyed value iff the run has no candidate, which then matches
+    no row of the run).
     """
     n = key.shape[0]
     is_real = key != PAD_KEY
@@ -212,12 +232,12 @@ def _visible_state_one_doc(key, op, action, value, pred, over):
     run_start = jnp.searchsorted(key, key, side="left")
     run_end = jnp.searchsorted(key, key, side="right") - 1
 
-    # winner: the last visible set row of each key run. cummax of visible-set
-    # indices gives the last such row up to any position; evaluate at the
-    # run's end.
-    idx = jnp.arange(n)
-    lv = jax.lax.cummax(jnp.where(visible_set, idx, -1))
-    winner = visible_set & (lv[run_end] == idx)
+    # winner: the visible set row with the greatest cmp in its key run.
+    packed = jnp.where(
+        visible_set, (key.astype(jnp.int64) << _MKEY_OP_BITS) | cmp, jnp.int64(-1)
+    )
+    run_max = jax.lax.cummax(packed)[run_end]
+    winner = visible_set & (packed == run_max)
 
     # live increments: an inc is live iff its target set op is not
     # overwritten. The target shares the inc's key, so locate it by merge
@@ -241,14 +261,28 @@ def _visible_state_one_doc(key, op, action, value, pred, over):
 
 
 @jax.jit
-def batched_visible_state(state: BatchedDocState):
-    """Materialises the visible state of every document: the device-side
-    equivalent of documentPatch (new.js:1604). Returns per-row
-    (key, op, winner, value_total) arrays of shape [docs, capacity]."""
+def _batched_visible_state_cmp(state: BatchedDocState, cmp):
     return jax.vmap(_visible_state_one_doc)(
         state.key, state.op, state.action, state.value, state.pred,
-        state.overwritten,
+        state.overwritten, cmp,
     )
+
+
+def batched_visible_state(state: BatchedDocState, actor_rank=None):
+    """Materialises the visible state of every document: the device-side
+    equivalent of documentPatch (new.js:1604). Returns per-row
+    (key, op, winner, value_total) arrays of shape [docs, capacity].
+
+    `actor_rank` (int32[A], actor intern index -> lexicographic rank) makes
+    counter-tied conflicts resolve on the actor id string exactly like the
+    reference; without it, ties break on actor intern order (sufficient for
+    single-engine convergence, not for cross-engine parity).
+    """
+    if actor_rank is None:
+        cmp = state.op
+    else:
+        cmp = remap_opid_actors(state.op, actor_rank)
+    return _batched_visible_state_cmp(state, cmp)
 
 
 class BatchedMapEngine:
@@ -272,8 +306,8 @@ class BatchedMapEngine:
         self.state = batched_apply_ops(self.state, changes)
         return self.state
 
-    def visible_state(self):
-        return batched_visible_state(self.state)
+    def visible_state(self, actor_rank=None):
+        return batched_visible_state(self.state, actor_rank=actor_rank)
 
 
 def _grow_state(state: BatchedDocState, capacity: int) -> BatchedDocState:

@@ -1,0 +1,166 @@
+"""Device-side batched RGA ordering: document order as a parallel rank
+computation.
+
+The reference determines a list element's position with a sequential scan:
+insert after the reference element, skipping over existing elements with a
+greater opId (/root/reference/backend/new.js:144-163, the loop "Skip over any
+list elements with greater ID than the new one"). SURVEY.md §7 flags this as
+the main algorithmic redesign for a TPU build: the scan must become a rank
+computation.
+
+The redesign rests on the tree equivalence of RGA:
+
+- Every element names the element it was inserted after (its *parent*; the
+  virtual head for position 0), so the elements of a list object form a
+  forest rooted at the head.
+- By causal delivery, an element's Lamport opId is strictly greater than its
+  parent's (you can only insert after an element you have already seen, and
+  new opIds exceed every opId seen so far -- maxOp tracking,
+  /root/reference/backend/new.js:1818). Hence every element of a subtree has
+  a greater opId than the subtree's root.
+- Therefore the reference's skip rule ("skip elements with greater opId")
+  skips exactly the subtrees of the new element's greater-opId siblings, and
+  the resulting document order is the depth-first preorder of the tree with
+  each node's children ordered by **descending** opId.
+
+That preorder is computed here entirely on device, batched over documents,
+with O(log E) depth per document of E elements:
+
+  1. one sort groups siblings contiguously in descending-opId order
+     (jnp.argsort over a packed (parent, ~opId) key),
+  2. `next sibling` / `first child` come from neighbours and binary searches
+     in the sorted order,
+  3. `next sibling of the nearest ancestor` resolves by pointer doubling up
+     the parent chain (log2 E gather rounds),
+  4. each node's DFS successor = first child, else that ancestor sibling --
+     giving the document order as a linked list, whose ranks are computed by
+     Wyllie's pointer-doubling list ranking (log2 E gather rounds).
+
+Ties between concurrent opIds with equal counters are broken by the actor id
+-- compared as *strings* in the reference (new.js:146: `nextIdActor >
+idActor`). Packed opIds carry an interned actor index, so callers pass an
+`actor_rank` table mapping intern index -> lexicographic rank, and the
+kernel compares remapped opIds (see `remap_opid_actors`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .engine import remap_opid_actors
+
+# Packed opIds are (counter << 20 | actor), 44 significant bits. The
+# sibling-sort composite packs (parent+1) above them, so documents are
+# limited to MAX_ELEMS elements (tombstones included) and op counters to
+# 2^24; callers must guard (text_engine._grow_elems does).
+_OP_BITS = 44
+_OP_MASK = (1 << _OP_BITS) - 1
+_I64_MAX = jnp.iinfo(jnp.int64).max
+MAX_ELEMS = 1 << 19
+MAX_COUNTER = 1 << 24
+
+
+def _rga_rank_one_doc(parent, opid, valid):
+    """Ranks one document's elements in RGA document order.
+
+    parent: int32[E] slot index of the insertion reference (-1 = head).
+    opid:   int64[E] packed opId, already actor-rank-remapped for ties.
+    valid:  bool[E].
+    Returns int32[E]: 0-based document order; invalid slots get E.
+    """
+    e = parent.shape[0]
+    doubling_rounds = max(int(e - 1).bit_length(), 1)
+    sent = e  # sentinel node: end-of-list / virtual root's "no next"
+
+    # --- 1. sibling sort: group by parent, descending opId within a group.
+    # Composite key: (parent+1) in the high bits, bitwise-complemented opId
+    # low, so ascending sort = (parent asc, opId desc). parent+1 <= E needs
+    # E < 2^19 to stay within int64 alongside 44 opId bits.
+    comp = jnp.where(
+        valid,
+        ((parent.astype(jnp.int64) + 1) << _OP_BITS) | (_OP_MASK - (opid & _OP_MASK)),
+        _I64_MAX,
+    )
+    order = jnp.argsort(comp)          # sorted pos -> slot
+    comp_sorted = comp[order]
+    parent_sorted = jnp.where(valid[order], parent[order], jnp.int32(-2))
+    inv_order = jnp.argsort(order)     # slot -> sorted pos
+
+    # --- 2. neighbours in sorted space.
+    # next sibling: the following row when it shares the parent.
+    nxt_parent = jnp.roll(parent_sorted, -1)
+    has_next_sib = (jnp.arange(e) + 1 < e) & (nxt_parent == parent_sorted) & (
+        parent_sorted != -2
+    )
+    next_sib = jnp.where(has_next_sib, jnp.arange(e) + 1, sent)
+
+    # first child of slot s: leftmost sorted row whose parent key is s
+    # (search the sorted composite's high bits).
+    pc = comp_sorted >> _OP_BITS       # parent+1 per sorted row (huge for pads)
+    slots = jnp.arange(e, dtype=jnp.int64)
+    fc_pos = jnp.searchsorted(pc, slots + 1)
+    has_child = (fc_pos < e) & (pc[jnp.minimum(fc_pos, e - 1)] == slots + 1)
+    first_child = jnp.where(has_child, fc_pos, sent).astype(jnp.int32)  # slot -> sorted pos
+
+    # --- 3. next-sibling-of-nearest-ancestor by pointer doubling.
+    # State per sorted row: res = resolved successor (or sent=unresolved yet
+    # exhausted), up = sorted pos of the parent (sent once past the root).
+    # Rows are extended by one sentinel row that resolves to itself.
+    parent_pos = jnp.where(
+        parent_sorted >= 0, inv_order[jnp.maximum(parent_sorted, 0)], sent
+    )
+    res = jnp.where(has_next_sib, next_sib, jnp.where(parent_pos == sent, sent, -1))
+    res = jnp.append(res, sent)        # sentinel row
+    up = jnp.append(parent_pos, sent)
+
+    def anc_step(_, carry):
+        res, up = carry
+        unresolved = res == -1
+        res2 = jnp.where(unresolved, res[up], res)
+        # res[up] may itself be -1; keep climbing
+        up2 = jnp.where(res2 == -1, up[up], up)
+        return res2, up2
+
+    res, up = jax.lax.fori_loop(0, doubling_rounds, anc_step, (res, up))
+    anc_next = jnp.where(res[:e] == -1, sent, res[:e])
+
+    # --- 4. DFS successor, then Wyllie list ranking.
+    slot_of_row = order                       # sorted pos -> slot
+    fc_of_row = first_child[slot_of_row]      # this row's first child (sorted pos)
+    succ = jnp.where(fc_of_row != sent, fc_of_row, anc_next)
+    succ = jnp.where(valid[slot_of_row], succ, sent)
+    succ = jnp.append(succ, sent)
+
+    dist = jnp.append(
+        jnp.where(valid[slot_of_row], jnp.int32(1), jnp.int32(0)), jnp.int32(0)
+    )
+
+    def rank_step(_, carry):
+        dist, ptr = carry
+        return dist + dist[ptr], ptr[ptr]
+
+    dist, _ = jax.lax.fori_loop(0, doubling_rounds + 1, rank_step, (dist, succ))
+
+    # dist[row] = #elements from this row (inclusive) to the end of the list.
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    rank_sorted = jnp.where(valid[slot_of_row], n_valid - dist[:e], e)
+    return rank_sorted[inv_order].astype(jnp.int32)
+
+
+@jax.jit
+def batched_rga_rank(parent, opid, valid, actor_rank):
+    """Document-order ranks for a batch of list objects.
+
+    parent: int32[docs, E] insertion-reference slot (-1 = head).
+    opid:   int64[docs, E] packed opIds (counter << 20 | actor intern index).
+    valid:  bool[docs, E].
+    actor_rank: int32[A] lexicographic rank per actor intern index.
+    Returns int32[docs, E] ranks; invalid slots get E.
+    """
+    if parent.shape[-1] > MAX_ELEMS:
+        raise ValueError(
+            f"document element table exceeds MAX_ELEMS={MAX_ELEMS}; the "
+            "sibling-sort key packing would overflow int64"
+        )
+    remapped = remap_opid_actors(opid, actor_rank)
+    return jax.vmap(_rga_rank_one_doc)(parent, remapped, valid)

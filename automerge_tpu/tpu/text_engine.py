@@ -2,20 +2,24 @@
 
 Division of labour (SURVEY.md §7 'Architecture mapping'):
 
-- **Host**: RGA insertion ordering. Each element's document position follows
-  the reference rule "insert after the reference element, skipping concurrent
-  elements with greater opId" (new.js:144-163). The host maintains the
-  element order per document and assigns each element a dense rank; runs of
-  consecutive insertions (typing) are located once per run.
-- **Device**: everything per-element: update/delete visibility (succ
-  marking), conflict resolution (max-opId winner per element), and the
-  visible-text extraction, batched over all documents with the same
-  gather/scan kernels as the map engine (engine.py) using the element rank
-  as the key.
+- **Host**: transcoding only. Each insert op is assigned a stable slot in a
+  per-document element table; elemId strings resolve to slots through a
+  dict. No ordering work happens on the host.
+- **Device**: everything else, batched over documents --
+  * document order: the RGA insertion order ("insert after the reference
+    element, skipping concurrent elements with greater opId",
+    /root/reference/backend/new.js:144-163) computed as a parallel rank
+    over the insertion tree (rga.batched_rga_rank: sort + pointer doubling,
+    O(log E) depth);
+  * visibility and conflicts: update/delete succ marking and max-opId
+    winner per element via the map-engine kernels (engine.py), keyed by the
+    element's slot;
+  * counter-tie conflict resolution on the actor id *string* via the
+    actor-rank remap (new.js:146, apply_patch.js:33).
 
-This covers benchmark config 2 (concurrent insert/delete on Text). The rank
-keys are rebuilt per flush; order-maintenance labels (skip lists) are the
-planned upgrade for very long documents.
+This covers benchmark config 2 (concurrent insert/delete on Text). The host
+scan-based order (`HostDocOrder`) is retained purely as a differential-test
+oracle for the device kernel.
 """
 from __future__ import annotations
 
@@ -26,14 +30,17 @@ from .engine import (
     ACTION_DEL,
     ACTION_SET,
     BatchedMapEngine,
-    ChangeOpsBatch,
     PAD_KEY,
     changes_from_numpy,
 )
+from . import rga
+from .rga import batched_rga_rank
 
 
-class _DocOrder:
-    """Host-side RGA order for one document's list object."""
+class HostDocOrder:
+    """Host-side RGA order for one document's list object — the sequential
+    reference scan (new.js:144-163), kept as the oracle the device rank
+    kernel is differentially tested against."""
 
     __slots__ = ("elems", "pos", "dirty")
 
@@ -70,19 +77,26 @@ class _DocOrder:
         return self.pos
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(int(n - 1).bit_length(), 0) if n > 1 else 1
+
+
 class BatchedTextEngine:
     """Driver for a batch of Text documents (one list object per doc)."""
 
     def __init__(self, num_docs: int, capacity: int = 256):
         self.num_docs = num_docs
-        self.orders = [_DocOrder() for _ in range(num_docs)]
         self.engine = BatchedMapEngine(num_docs, capacity)
         self.values = []  # interned element values
         self._value_index = {}
-        self.elem_rank = [dict() for _ in range(num_docs)]  # packed elemId -> key used on device
-        self._rank_alloc = [0] * num_docs
         self.actors = []
         self._actor_index = {}
+        # element tables: stable slot per insert op, in arrival order
+        self.elem_capacity = capacity
+        self.elem_opid = np.zeros((num_docs, capacity), np.int64)
+        self.elem_parent = np.full((num_docs, capacity), -1, np.int32)
+        self.num_elems = np.zeros(num_docs, np.int32)
+        self.elem_slot = [dict() for _ in range(num_docs)]  # elemId -> slot
 
     def _actor(self, actor_id):
         idx = self._actor_index.get(actor_id)
@@ -104,36 +118,74 @@ class BatchedTextEngine:
         p = parse_op_id(op_id)
         return (p.counter << 20) | self._actor(p.actor_id)
 
+    def _actor_rank(self) -> np.ndarray:
+        """Lexicographic rank per actor intern index, padded to a power of
+        two so the jitted kernels see few distinct shapes."""
+        n = max(len(self.actors), 1)
+        ranks = np.zeros(_next_pow2(n), np.int32)
+        order = sorted(range(len(self.actors)), key=lambda i: self.actors[i])
+        for rank, i in enumerate(order):
+            ranks[i] = rank
+        return ranks
+
+    def _grow_elems(self, needed: int):
+        if needed > rga.MAX_ELEMS:
+            raise ValueError(
+                f"text document exceeds {rga.MAX_ELEMS} elements (incl. "
+                "tombstones): beyond the rank kernel's key-packing range"
+            )
+        while needed > self.elem_capacity:
+            pad = self.elem_capacity
+            self.elem_opid = np.concatenate(
+                [self.elem_opid, np.zeros((self.num_docs, pad), np.int64)], axis=1
+            )
+            self.elem_parent = np.concatenate(
+                [self.elem_parent, np.full((self.num_docs, pad), -1, np.int32)],
+                axis=1,
+            )
+            self.elem_capacity *= 2
+
     def apply_batch(self, per_doc_ops):
         """Applies one round of change ops per document. Each op is a tuple
         (op_dict, op_counter, actor). Supported actions: insert 'set',
         non-insert 'set' (element overwrite), and 'del'."""
+        max_new = max(
+            (sum(1 for op, _, _ in doc_ops if op.get("insert"))
+             for doc_ops in per_doc_ops),
+            default=0,
+        )
+        self._grow_elems(int(self.num_elems.max(initial=0)) + max_new)
+
         rows = []
         for d, doc_ops in enumerate(per_doc_ops):
-            order = self.orders[d]
+            slots = self.elem_slot[d]
             doc_rows = []
             for op, ctr, actor in doc_ops:
+                if ctr >= rga.MAX_COUNTER:
+                    raise ValueError(
+                        f"op counter {ctr} exceeds the rank kernel's "
+                        f"{rga.MAX_COUNTER} packing range"
+                    )
                 op_id = f"{ctr}@{actor}"
                 packed = (ctr << 20) | self._actor(actor)
                 if op.get("insert"):
                     ref = op.get("elemId", "_head")
-                    order.insert(op_id, ref)
-                    key = self._rank_alloc[d]
-                    self._rank_alloc[d] += 1
-                    self.elem_rank[d][op_id] = key
+                    slot = int(self.num_elems[d])
+                    self.num_elems[d] += 1
+                    self.elem_opid[d, slot] = packed
+                    self.elem_parent[d, slot] = -1 if ref == "_head" else slots[ref]
+                    slots[op_id] = slot
                     doc_rows.append(
-                        (key, packed, ACTION_SET, self._value(op.get("value")), -1)
+                        (slot, packed, ACTION_SET, self._value(op.get("value")), -1)
                     )
                 elif op["action"] == "set":
-                    elem = op["elemId"]
-                    key = self.elem_rank[d][elem]
+                    key = slots[op["elemId"]]
                     pred = self._pack(op["pred"][0]) if op.get("pred") else -1
                     doc_rows.append(
                         (key, packed, ACTION_SET, self._value(op.get("value")), pred)
                     )
                 elif op["action"] == "del":
-                    elem = op["elemId"]
-                    key = self.elem_rank[d][elem]
+                    key = slots[op["elemId"]]
                     pred = self._pack(op["pred"][0]) if op.get("pred") else -1
                     doc_rows.append((key, packed, ACTION_DEL, 0, pred))
                 else:
@@ -155,24 +207,37 @@ class BatchedTextEngine:
                 preds[d, i] = p
         self.engine.apply_batch(changes_from_numpy(keys, ops, actions, values, preds))
 
+    def document_ranks(self, actor_rank=None) -> np.ndarray:
+        """Device-computed RGA document order: rank[d, slot] = position of
+        the element in doc d's sequence (tombstones included), or E for
+        empty slots."""
+        if actor_rank is None:
+            actor_rank = self._actor_rank()
+        valid = np.arange(self.elem_capacity)[None, :] < self.num_elems[:, None]
+        return np.asarray(
+            batched_rga_rank(self.elem_parent, self.elem_opid, valid, actor_rank)
+        )
+
     def visible_texts(self):
         """Extracts each document's visible element values in document order
-        (device visibility + host rank ordering)."""
-        keys, _ops, winners, vals = self.engine.visible_state()
+        (device rank kernel + device visibility)."""
+        actor_rank = self._actor_rank()
+        ranks = self.document_ranks(actor_rank)
+        keys, _ops, winners, vals = self.engine.visible_state(actor_rank=actor_rank)
         keys = np.asarray(keys)
         winners = np.asarray(winners)
         vals = np.asarray(vals)
         texts = []
         for d in range(self.num_docs):
-            # visible value per rank key
-            by_rank = {}
+            # visible value per element slot
+            by_slot = {}
             for i in np.nonzero(winners[d])[0]:
-                by_rank[int(keys[d, i])] = self.values[int(vals[d, i])]
-            ranks = self.elem_rank[d]
-            out = []
-            for elem_id in self.orders[d].elems:
-                rank = ranks[elem_id]
-                if rank in by_rank:
-                    out.append(by_rank[rank])
-            texts.append(out)
+                by_slot[int(keys[d, i])] = self.values[int(vals[d, i])]
+            n = int(self.num_elems[d])
+            order = np.argsort(ranks[d, : self.elem_capacity])
+            row = []
+            for slot in order[:n]:
+                if int(slot) in by_slot:
+                    row.append(by_slot[int(slot)])
+            texts.append(row)
         return texts
