@@ -228,9 +228,19 @@ def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     is_inc = is_real & (action == ACTION_INC)
     visible_set = is_set & ~over
 
-    # run boundaries of each row's key (key column is sorted)
-    run_start = jnp.searchsorted(key, key, side="left")
-    run_end = jnp.searchsorted(key, key, side="right") - 1
+    # run boundaries of each row's key: the key column is sorted, so a run
+    # starts where the key differs from its left neighbour and ends where it
+    # differs from its right neighbour. Each row's nearest boundary index is
+    # then recovered with one prefix max / suffix min over the boundary
+    # positions -- O(n) scans instead of searchsorted's O(n log n) binary
+    # search passes.
+    iota = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), key[1:] != key[:-1]])
+    is_end = jnp.concatenate([key[:-1] != key[1:], jnp.ones((1,), jnp.bool_)])
+    run_start = jax.lax.cummax(jnp.where(is_start, iota, -1))
+    run_end = jax.lax.cummin(
+        jnp.where(is_end, iota, jnp.iinfo(jnp.int32).max), reverse=True
+    )
 
     # winner: the visible set row with the greatest cmp in its key run.
     packed = jnp.where(

@@ -1,0 +1,222 @@
+"""Pallas TPU kernels for the sync protocol's Bloom filter hot path.
+
+The sync protocol probes every candidate change hash against every peer's
+`have` filter (reference backend/sync.js: getProbes:88, containsHash:116,
+addHash:107). At replica-farm scale that is B filters x C candidates x 7
+probes of bit tests — a bandwidth-bound bitwise workload that XLA executes
+as a chain of gathers. These kernels fuse the whole probe sequence in VMEM:
+
+- probe positions are computed with the reference's triple-hashing recurrence
+  (x += y; y += z, all mod filter size) unrolled NUM_PROBES times;
+- the word gather `words[probe >> 5]` is expressed as a one-hot matmul so it
+  rides the MXU instead of serialising into scalar gathers. uint32 words are
+  split into two uint16 halves so the f32 matmul is exact (one-hot rows sum
+  a single term < 2^16);
+- the grid tiles the entry/query axis and the word axis, OR-accumulating
+  into revisited output blocks, so every VMEM block stays a few MB no matter
+  how large the filter or candidate set grows (a 10k-change filter is ~3200
+  words; one-shot one-hots over that would be ~1 GB).
+
+On CPU the kernels run in the Pallas interpreter (tests); on TPU they are
+compiled. Results are bit-identical to the XLA reference implementations in
+sync_batch.py, which remain the default host API.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..sync import NUM_PROBES
+
+WORD_BITS = 32
+_LANES = 128
+# VMEM budgets: the one-hot intermediates are [P, ENTRY/QUERY_TILE, WORD_TILE]
+# f32 — 7 * 256 * 512 * 4 B = 3.5 MB, comfortably under ~16 MB VMEM.
+_ENTRY_TILE = 256
+_QUERY_TILE = 256
+_WORD_TILE = 512
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m or m
+
+
+def _probe_rows(xyz, modulo):
+    """Unrolled triple-hash probe positions. xyz: [C, 3] uint32, modulo
+    scalar uint32. Returns [NUM_PROBES, C] uint32."""
+    modulo = jnp.maximum(modulo, jnp.uint32(1))
+    x = xyz[:, 0] % modulo
+    y = xyz[:, 1] % modulo
+    z = xyz[:, 2] % modulo
+    rows = [x]
+    for _ in range(NUM_PROBES - 1):
+        x = (x + y) % modulo
+        y = (y + z) % modulo
+        rows.append(x)
+    return jnp.stack(rows)
+
+
+def _gather_words_mxu(words_u32, word_idx, num_words):
+    """words[word_idx] as a one-hot MXU contraction.
+
+    words_u32: [W] uint32, word_idx: [P, C] int32 (must be in [0, W)) ->
+    [P, C] uint32. The one-hot rows select exactly one element, and uint16
+    halves keep every f32 product exactly representable."""
+    lo = (words_u32 & jnp.uint32(0xFFFF)).astype(jnp.float32)  # [W]
+    hi = (words_u32 >> 16).astype(jnp.float32)
+    onehot = (word_idx[..., None] == jnp.arange(num_words, dtype=jnp.int32)).astype(
+        jnp.float32
+    )  # [P, C, W]
+    g_lo = jnp.einsum("pcw,w->pc", onehot, lo, preferred_element_type=jnp.float32)
+    g_hi = jnp.einsum("pcw,w->pc", onehot, hi, preferred_element_type=jnp.float32)
+    return g_lo.astype(jnp.uint32) | (g_hi.astype(jnp.uint32) << 16)
+
+
+def _bloom_query_kernel(words_ref, modulo_ref, xyz_ref, out_ref, *, num_words):
+    """One (filter, query-tile, word-tile) cell. Blocks: words [1, W_T],
+    modulo [1, 1] (SMEM), xyz [1, C_T, 3], out [1, P, C_T] int32 holding the
+    probed bit per (probe, query), OR-accumulated across word tiles (each
+    probe's word lives in exactly one tile, so the OR is exact). word_idx is
+    clamped to num_words - 1 exactly like sync_batch.query_filters' gather,
+    keeping the two implementations bit-identical even for over-sized moduli
+    (possible only when a caller undersizes num_words for the filter count)."""
+    w_idx = pl.program_id(2)
+    w_t = words_ref.shape[1]
+    modulo = modulo_ref[0, 0].astype(jnp.uint32)
+    probes = _probe_rows(xyz_ref[0], modulo)  # [P, C_T]
+    word_idx = jnp.minimum((probes // WORD_BITS).astype(jnp.int32), num_words - 1)
+    bit_idx = probes % WORD_BITS
+    local = word_idx - w_idx * w_t
+    in_tile = (local >= 0) & (local < w_t)
+    gathered = _gather_words_mxu(
+        words_ref[0], jnp.where(in_tile, local, 0), w_t
+    )
+    bit_set = jnp.where(in_tile, (gathered >> bit_idx) & jnp.uint32(1), 0).astype(
+        jnp.int32
+    )
+
+    @pl.when(w_idx == 0)
+    def _init():
+        out_ref[0] = bit_set
+
+    @pl.when(w_idx > 0)
+    def _accumulate():
+        out_ref[0] = out_ref[0] | bit_set
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def bloom_query(words, modulo, counts, query_xyz, *, interpret=False):
+    """Pallas analogue of sync_batch.query_filters.
+
+    words: [B, W] uint32, modulo: [B] int32, counts: [B] int32,
+    query_xyz: [B, C, 3] uint32. Returns [B, C] bool."""
+    batch, num_words = words.shape
+    _, c, _ = query_xyz.shape
+    w_t = min(_pad_to(num_words, _LANES), _WORD_TILE)
+    c_t = min(_pad_to(c, _LANES), _QUERY_TILE)
+    w_pad = _pad_to(num_words, w_t)
+    c_pad = _pad_to(c, c_t)
+    words = jnp.pad(words, ((0, 0), (0, w_pad - num_words)))
+    query_xyz = jnp.pad(query_xyz, ((0, 0), (0, c_pad - c), (0, 0)))
+
+    bits = pl.pallas_call(
+        partial(_bloom_query_kernel, num_words=num_words),
+        grid=(batch, c_pad // c_t, w_pad // w_t),
+        in_specs=[
+            pl.BlockSpec((1, w_t), lambda b, q, w: (b, w), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda b, q, w: (b, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, c_t, 3), lambda b, q, w: (b, q, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, NUM_PROBES, c_t), lambda b, q, w: (b, 0, q), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, NUM_PROBES, c_pad), jnp.int32),
+        interpret=interpret,
+    )(
+        words,
+        modulo.reshape(batch, 1).astype(jnp.int32),
+        query_xyz,
+    )
+    all_set = jnp.min(bits[:, :, :c], axis=1)
+    return jnp.where(counts[:, None] > 0, all_set, 0).astype(jnp.bool_)
+
+
+def _bloom_build_kernel(xyz_ref, modulo_ref, count_ref, out_ref):
+    """One (filter, word-tile, entry-tile) cell. Blocks: xyz [1, E_T, 3],
+    modulo/count [1, 1] (SMEM), out words [1, W_T] int32, OR-accumulated
+    across entry tiles (the innermost grid axis, so the block is revisited
+    consecutively)."""
+    w_idx = pl.program_id(1)
+    e_idx = pl.program_id(2)
+    e_t = xyz_ref.shape[1]
+    w_t = out_ref.shape[1]
+    modulo = modulo_ref[0, 0].astype(jnp.uint32)
+    count = count_ref[0, 0]
+    probes = _probe_rows(xyz_ref[0], modulo)  # [P, E_T]
+    word_idx = (probes // WORD_BITS).astype(jnp.int32)
+    bit = jnp.uint32(1) << (probes % WORD_BITS)
+    global_e = e_idx * e_t + jax.lax.broadcasted_iota(
+        jnp.int32, (NUM_PROBES, e_t), 1
+    )
+    entry_ok = global_e < count
+    # OR-accumulate per word without scatters: for each word lane w of this
+    # tile, fold together the bits of every probe that lands in w.
+    local = word_idx - w_idx * w_t
+    hit = (local[..., None] == jnp.arange(w_t, dtype=jnp.int32)) & entry_ok[..., None]
+    contrib = jnp.where(hit, bit[..., None], jnp.uint32(0))
+    words = jax.lax.reduce(
+        contrib, jnp.uint32(0), jax.lax.bitwise_or, dimensions=(0, 1)
+    ).astype(jnp.int32)  # [W_T]
+
+    @pl.when(e_idx == 0)
+    def _init():
+        out_ref[0, :] = words
+
+    @pl.when(e_idx > 0)
+    def _accumulate():
+        out_ref[0, :] = out_ref[0, :] | words
+
+
+@partial(jax.jit, static_argnames=("num_words", "interpret"))
+def bloom_build(xyz, counts, num_words: int, *, interpret=False):
+    """Pallas analogue of sync_batch.build_filters.
+
+    xyz: [B, E, 3] uint32, counts: [B] int32. Returns (words [B, num_words]
+    uint32, modulo [B] int32) exactly like sync_batch.build_filters."""
+    from .sync_batch import filter_modulo
+
+    batch, e, _ = xyz.shape
+    modulo = filter_modulo(counts)
+    e_t = min(_pad_to(e, 8), _ENTRY_TILE)
+    w_t = min(_pad_to(num_words, _LANES), _WORD_TILE)
+    e_pad = _pad_to(e, e_t)
+    w_pad = _pad_to(num_words, w_t)
+    xyz = jnp.pad(xyz, ((0, 0), (0, e_pad - e), (0, 0)))
+
+    words = pl.pallas_call(
+        _bloom_build_kernel,
+        grid=(batch, w_pad // w_t, e_pad // e_t),
+        in_specs=[
+            pl.BlockSpec(
+                (1, e_t, 3), lambda b, w, ei: (b, ei, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, 1), lambda b, w, ei: (b, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, w, ei: (b, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, w_t), lambda b, w, ei: (b, w), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, w_pad), jnp.int32),
+        interpret=interpret,
+    )(
+        xyz,
+        modulo.reshape(batch, 1).astype(jnp.int32),
+        counts.reshape(batch, 1).astype(jnp.int32),
+    )
+    return words[:, :num_words].astype(jnp.uint32), modulo

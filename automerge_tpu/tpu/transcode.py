@@ -4,8 +4,19 @@ The variable-length columnar encodings (LEB128/RLE, backend/encoding.js) are
 hostile to fixed-width SIMD, so the TPU engine works on dense interned
 tensors: actors, keys and values are interned into per-batch tables on the
 host, and ops become int32/int64 rows (SURVEY.md §7 'Architecture mapping').
-"""
+
+Nested objects (maps inside maps, tables of rows — reference semantics in
+frontend/context.js createNestedObjects:230 and backend/new.js objectMeta)
+need no new device kernels: the engine's sort key is an opaque int32, so the
+transcoder interns the *(objectId, key)* pair into one "slot" id. Rows of one
+(object, key) stay contiguous under the sort, succ/visibility/conflict
+resolution are per-slot and therefore per-(object, key), exactly like the
+reference's (objectId, key) op grouping (new.js:1153-1224). makeMap/makeTable
+ops become set-ops whose value is a child reference; the host rebuilds the
+tree from the flat winner rows."""
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import numpy as np
 
@@ -21,6 +32,17 @@ from ..common import parse_op_id
 
 _COUNTER_TAG = object()
 
+# Slot ids ride the high bits of the engine's packed int64 merge key
+# (slot << 44 | opid): 63 value bits - 44 opid bits = 19 bits of slot before
+# the sign bit flips and the sorted-table invariant silently breaks.
+_MAX_SLOTS = 1 << 19
+
+
+class ChildRef(NamedTuple):
+    """Interned value marking 'this key holds the object with this id'."""
+
+    object_id: str
+
 
 class _Interner:
     def __init__(self):
@@ -28,8 +50,15 @@ class _Interner:
         self.index = {}
 
     def intern(self, value) -> int:
-        key = value if isinstance(value, (str, int, float, bool, bytes, type(None))) else id(value)
-        idx = self.index.get(key)
+        # Key by (class, value): Python equates 1 == True and
+        # tuple == NamedTuple (so a user tuple could collide with a ChildRef
+        # under plain value keying), but distinct classes must intern apart.
+        try:
+            key = (value.__class__, value)
+            idx = self.index.get(key)
+        except TypeError:  # unhashable (lists/dicts) — identity-intern
+            key = id(value)
+            idx = self.index.get(key)
         if idx is None:
             idx = len(self.table)
             self.table.append(value)
@@ -41,33 +70,46 @@ class _Interner:
 
 
 class BatchTranscoder:
-    """Interns actors/keys/values for one document batch and packs change ops
-    into ChangeOpsBatch tensors."""
+    """Interns actors/(object, key) slots/values for one document batch and
+    packs change ops into ChangeOpsBatch tensors."""
 
     def __init__(self):
         self.actors = _Interner()
-        self.keys = _Interner()
+        self.slots = _Interner()  # (objectId, key) pair -> int slot id
         self.values = _Interner()
+        self.object_types = {"_root": "map"}  # objectId -> map | table
 
     def pack_opid_str(self, op_id: str) -> int:
         p = parse_op_id(op_id)
         return (p.counter << 20) | self.actors.intern(p.actor_id)
 
+    def slot_id(self, obj: str, key: str) -> int:
+        slot = self.slots.intern((obj, key))
+        if slot >= _MAX_SLOTS:
+            raise ValueError("slot table overflow: > 2^19 (object, key) pairs in batch")
+        return slot
+
     def op_row(self, op: dict, op_counter: int, actor: str):
-        """Converts one root-map change op dict (frontend format) into a dense
-        row (key, op, action, value, pred)."""
+        """Converts one map-family change op dict (frontend format) into a
+        dense row (slot, op, action, value, pred). Supports set/inc/del on
+        maps and table rows, plus makeMap/makeTable child creation."""
         packed_id = (op_counter << 20) | self.actors.intern(actor)
-        key_id = self.keys.intern(op["key"])
+        slot = self.slot_id(op.get("obj", "_root"), op["key"])
         pred = self.pack_opid_str(op["pred"][0]) if op.get("pred") else -1
         action = op["action"]
         if action == "set":
             if op.get("datatype") == "counter":
-                return key_id, packed_id, ACTION_SET, int(op["value"]), pred
-            return key_id, packed_id, ACTION_SET, self.values.intern(op.get("value")), pred
+                return slot, packed_id, ACTION_SET, int(op["value"]), pred
+            return slot, packed_id, ACTION_SET, self.values.intern(op.get("value")), pred
+        if action in ("makeMap", "makeTable"):
+            child_id = f"{op_counter}@{actor}"
+            self.object_types[child_id] = "map" if action == "makeMap" else "table"
+            value = self.values.intern(ChildRef(child_id))
+            return slot, packed_id, ACTION_SET, value, pred
         if action == "inc":
-            return key_id, packed_id, ACTION_INC, int(op["value"]), pred
+            return slot, packed_id, ACTION_INC, int(op["value"]), pred
         if action == "del":
-            return key_id, packed_id, ACTION_DEL, 0, pred
+            return slot, packed_id, ACTION_DEL, 0, pred
         raise ValueError(f"Unsupported op action for the dense engine: {action}")
 
     def changes_to_batch(self, per_doc_ops, width=None) -> ChangeOpsBatch:
@@ -87,23 +129,35 @@ class BatchTranscoder:
                 )
         return changes_from_numpy(keys, ops, actions, values, preds)
 
-    def decode_visible(self, keys, ops, winners, values, counter_keys=()):
+    def decode_visible(self, keys, ops, winners, values, counter_slots=()):
         """Converts one document's per-row visibility tensors (from
-        batched_visible_state) back into a Python dict. `counter_keys` is the
-        set of interned key ids whose winning value is a raw counter total
-        rather than an interned ref."""
-        result = {}
-        counter_keys = set(counter_keys)
+        batched_visible_state) back into the document's Python tree, rooted
+        at `_root`. `counter_slots` is the set of slot ids whose winning
+        value is a raw counter total rather than an interned ref. Nested
+        maps/table rows appear as nested dicts, reconstructed by following
+        ChildRef winner values — the host-side analogue of the reference's
+        objectMeta tree walk (new.js:1461, setupPatches)."""
+        counter_slots = set(counter_slots)
         keys = np.asarray(keys)
         winners = np.asarray(winners)
         values = np.asarray(values)
+        # flat winner table: objectId -> {key: scalar | ChildRef}
+        objects = {}
         for i in np.nonzero(winners)[0]:
-            key_id = int(keys[i])
-            if key_id == PAD_KEY:
+            slot = int(keys[i])
+            if slot == PAD_KEY:
                 continue
-            key = self.keys.lookup(key_id)
-            if key_id in counter_keys:
-                result[key] = int(values[i])
+            obj, key = self.slots.lookup(slot)
+            if slot in counter_slots:
+                value = int(values[i])
             else:
-                result[key] = self.values.lookup(int(values[i]))
-        return result
+                value = self.values.lookup(int(values[i]))
+            objects.setdefault(obj, {})[key] = value
+
+        def build(object_id):
+            out = {}
+            for key, value in objects.get(object_id, {}).items():
+                out[key] = build(value.object_id) if isinstance(value, ChildRef) else value
+            return out
+
+        return build("_root")

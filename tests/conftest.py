@@ -2,10 +2,21 @@ import os
 import sys
 
 # Force JAX onto a virtual 8-device CPU mesh for sharding tests; the real TPU
-# is used only by bench.py. Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# is used only by bench.py. Must be set before jax is imported anywhere, and
+# must OVERRIDE any externally-set platform (the driver environment points
+# JAX_PLATFORMS at the tunnelled TPU, whose per-shape compiles are far too
+# slow for a test suite and whose device lock serialises concurrent runs).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize hook may have already imported jax AND called
+# jax.config.update("jax_platforms", "<tpu>,cpu") during interpreter startup,
+# which takes precedence over the env var. Re-update the config so the first
+# backend initialisation in this process is CPU-only; otherwise every jnp call
+# blocks on the tunnelled-TPU handshake.
+if "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,87 @@
+"""Pallas Bloom kernels must be bit-identical to the XLA reference kernels
+in sync_batch.py (which are themselves wire-format-identical to
+backend/sync.js — see test_sync_batch.py). Runs in interpreter mode on CPU."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from automerge_tpu.tpu import sync_batch  # noqa: E402
+from automerge_tpu.tpu.pallas_kernels import bloom_build, bloom_query  # noqa: E402
+
+INTERPRET = jax.default_backend() != "tpu"
+
+
+def random_xyz(rng, batch, entries):
+    return jnp.asarray(
+        rng.integers(0, 2**32, size=(batch, entries, 3), dtype=np.uint32)
+    )
+
+
+class TestPallasBloom:
+    def test_build_matches_xla(self):
+        rng = np.random.default_rng(0)
+        xyz = random_xyz(rng, batch=5, entries=12)
+        counts = jnp.asarray([12, 7, 1, 0, 3], jnp.int32)
+        num_words = 16
+
+        ref_words, ref_modulo = sync_batch.build_filters(xyz, counts, num_words)
+        got_words, got_modulo = bloom_build(
+            xyz, counts, num_words, interpret=INTERPRET
+        )
+        np.testing.assert_array_equal(np.asarray(got_modulo), np.asarray(ref_modulo))
+        np.testing.assert_array_equal(np.asarray(got_words), np.asarray(ref_words))
+
+    def test_query_matches_xla(self):
+        rng = np.random.default_rng(1)
+        batch, entries, queries = 4, 10, 9
+        xyz = random_xyz(rng, batch, entries)
+        counts = jnp.asarray([10, 5, 0, 2], jnp.int32)
+        num_words = 8
+        words, modulo = sync_batch.build_filters(xyz, counts, num_words)
+
+        # half the queries are members, half are random
+        member = np.asarray(xyz)[:, :queries // 2]
+        other = rng.integers(0, 2**32, size=(batch, queries - queries // 2, 3),
+                             dtype=np.uint32)
+        query = jnp.asarray(np.concatenate([member, other], axis=1))
+
+        ref = sync_batch.query_filters(words, modulo, counts, query)
+        got = bloom_query(words, modulo, counts, query, interpret=INTERPRET)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_members_always_contained(self):
+        rng = np.random.default_rng(2)
+        xyz = random_xyz(rng, batch=3, entries=20)
+        counts = jnp.asarray([20, 20, 20], jnp.int32)
+        num_words = 16
+        words, modulo = bloom_build(xyz, counts, num_words, interpret=INTERPRET)
+        got = bloom_query(words, modulo, counts, xyz, interpret=INTERPRET)
+        assert bool(jnp.all(got))
+
+    def test_multi_tile_grid_matches_xla(self):
+        """Entry/query/word counts that exceed one grid tile (the VMEM-bounded
+        path real replica-farm sizes take)."""
+        from automerge_tpu.tpu import pallas_kernels as pk
+
+        rng = np.random.default_rng(3)
+        entries = pk._ENTRY_TILE + 37
+        num_words = pk._WORD_TILE + pk._LANES
+        queries = pk._QUERY_TILE + 19
+        xyz = random_xyz(rng, batch=2, entries=entries)
+        counts = jnp.asarray([entries, entries - 50], jnp.int32)
+
+        ref_words, ref_modulo = sync_batch.build_filters(xyz, counts, num_words)
+        got_words, got_modulo = bloom_build(xyz, counts, num_words, interpret=INTERPRET)
+        np.testing.assert_array_equal(np.asarray(got_modulo), np.asarray(ref_modulo))
+        np.testing.assert_array_equal(np.asarray(got_words), np.asarray(ref_words))
+
+        member = np.asarray(xyz)[:, : queries // 2]
+        other = rng.integers(
+            0, 2**32, size=(2, queries - queries // 2, 3), dtype=np.uint32
+        )
+        query = jnp.asarray(np.concatenate([member, other], axis=1))
+        ref = sync_batch.query_filters(ref_words, ref_modulo, counts, query)
+        got = bloom_query(got_words, got_modulo, counts, query, interpret=INTERPRET)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))

@@ -11,22 +11,30 @@ from automerge_tpu.columnar import encode_change
 from automerge_tpu.opset import OpSet
 
 
-def opset_visible_map(opset):
-    """Extracts the visible root-map state (and counter totals) from the
-    sequential engine's patch."""
-    patch = opset.get_patch()
+def opset_visible_tree(patch_diff):
+    """Materialises the visible tree (winner per prop = max Lamport opId,
+    apply_patch.js:33) from an OpSet patch diff — the single oracle for both
+    the flat and nested differential suites."""
+    def lamport(op_id):
+        ctr, actor = op_id.split("@")
+        return (int(ctr), actor)
+
     result = {}
-    for key, values in patch["diffs"]["props"].items():
+    for key, values in patch_diff.get("props", {}).items():
         if not values:
             continue
-        # winner = max Lamport opId (apply_patch.js:33)
-        def lamport(op_id):
-            ctr, actor = op_id.split("@")
-            return (int(ctr), actor)
-
         winner = max(values.keys(), key=lamport)
-        result[key] = values[winner].get("value")
+        diff = values[winner]
+        if "objectId" in diff:
+            result[key] = opset_visible_tree(diff)
+        else:
+            result[key] = diff.get("value")
     return result
+
+
+def opset_visible_map(opset):
+    """Visible root-map state of the sequential engine."""
+    return opset_visible_tree(opset.get_patch()["diffs"])
 
 
 def run_differential(num_docs, num_rounds, ops_per_round, seed, with_counters=False):
@@ -76,7 +84,7 @@ def run_differential(num_docs, num_rounds, ops_per_round, seed, with_counters=Fa
                     datatype = op.get("datatype")
                     last_op[d][op["key"]] = (f"{ctr}@{actor}", "counter" if datatype == "counter" else "plain")
                     if datatype == "counter":
-                        counter_keys[d].add(tr.keys.intern(op["key"]))
+                        counter_keys[d].add(tr.slot_id("_root", op["key"]))
                 rows.append((op, ctr, actor))
                 ctr += 1
             max_ops[d] = ctr - 1
@@ -153,7 +161,7 @@ class TestBatchedMapEngine:
              ({"action": "inc", "obj": "_root", "key": "c", "value": 4,
                "pred": ["1@aaaaaaaa"]}, 2, "bbbbbbbb")],
         ]))
-        ck = {tr.keys.intern("c")}
+        ck = {tr.slot_id("_root", "c")}
         keys, ops, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0], ck)
         assert doc == {"c": 17}
@@ -163,3 +171,99 @@ class TestBatchedMapEngine:
 
     def test_differential_with_counters(self):
         run_differential(num_docs=3, num_rounds=5, ops_per_round=3, seed=7, with_counters=True)
+
+
+class TestNestedObjects:
+    def test_make_map_and_set_inside(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "makeMap", "obj": "_root", "key": "child", "pred": []}, 1, "aaaaaaaa"),
+             ({"action": "set", "obj": "1@aaaaaaaa", "key": "x", "value": 7, "pred": []}, 2, "aaaaaaaa")],
+        ]))
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        assert doc == {"child": {"x": 7}}
+
+    def test_overwriting_child_ref_hides_subtree(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "makeMap", "obj": "_root", "key": "c", "pred": []}, 1, "aaaaaaaa"),
+             ({"action": "set", "obj": "1@aaaaaaaa", "key": "x", "value": 1, "pred": []}, 2, "aaaaaaaa"),
+             ({"action": "set", "obj": "_root", "key": "c", "value": "gone",
+               "pred": ["1@aaaaaaaa"]}, 3, "aaaaaaaa")],
+        ]))
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        assert doc == {"c": "gone"}
+
+    def test_table_rows(self):
+        engine = tpu.BatchedMapEngine(1, capacity=16)
+        tr = tpu.BatchTranscoder()
+        engine.apply_batch(tr.changes_to_batch([
+            [({"action": "makeTable", "obj": "_root", "key": "t", "pred": []}, 1, "aaaaaaaa"),
+             ({"action": "makeMap", "obj": "1@aaaaaaaa", "key": "row-1", "pred": []}, 2, "aaaaaaaa"),
+             ({"action": "set", "obj": "2@aaaaaaaa", "key": "name", "value": "ada", "pred": []}, 3, "aaaaaaaa")],
+        ]))
+        keys, ops, winners, values = engine.visible_state()
+        doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
+        assert doc == {"t": {"row-1": {"name": "ada"}}}
+        assert tr.object_types["1@aaaaaaaa"] == "table"
+
+    def test_nested_differential_vs_opset(self):
+        rng = random.Random(99)
+        actors = ["aaaaaaaa", "bbbbbbbb"]
+        num_docs, num_rounds = 3, 8
+
+        opsets = [OpSet() for _ in range(num_docs)]
+        engine = tpu.BatchedMapEngine(num_docs, capacity=128)
+        tr = tpu.BatchTranscoder()
+        # per-doc: objects list and last op per (obj, key)
+        objects = [["_root"] for _ in range(num_docs)]
+        last_op = [{} for _ in range(num_docs)]
+        seqs = [dict.fromkeys(actors, 0) for _ in range(num_docs)]
+        max_ops = [0] * num_docs
+
+        for _ in range(num_rounds):
+            per_doc_rows = []
+            for d in range(num_docs):
+                actor = rng.choice(actors)
+                seqs[d][actor] += 1
+                start_op = max_ops[d] + 1
+                ops = []
+                ctr = start_op
+                for _ in range(rng.randrange(1, 5)):
+                    obj = rng.choice(objects[d])
+                    key = f"k{rng.randrange(4)}"
+                    prev = last_op[d].get((obj, key))
+                    roll = rng.random()
+                    if roll < 0.25:
+                        op = {"action": "makeMap", "obj": obj, "key": key,
+                              "pred": [prev] if prev else []}
+                        objects[d].append(f"{ctr}@{actor}")
+                    elif roll < 0.35 and prev:
+                        op = {"action": "del", "obj": obj, "key": key, "pred": [prev]}
+                    else:
+                        op = {"action": "set", "obj": obj, "key": key,
+                              "datatype": "uint", "value": rng.randrange(1000),
+                              "pred": [prev] if prev else []}
+                    if op["action"] == "del":
+                        last_op[d].pop((obj, key), None)
+                    else:
+                        last_op[d][(obj, key)] = f"{ctr}@{actor}"
+                    ops.append(op)
+                    ctr += 1
+                max_ops[d] = ctr - 1
+                change = {"actor": actor, "seq": seqs[d][actor], "startOp": start_op,
+                          "time": 0, "deps": opsets[d].heads, "ops": ops}
+                opsets[d].apply_changes([encode_change(change)])
+                per_doc_rows.append([(op, start_op + i, actor) for i, op in enumerate(ops)])
+            # fixed width => one compiled shape across rounds
+            engine.apply_batch(tr.changes_to_batch(per_doc_rows, width=4))
+
+        keys, ops, winners, values = engine.visible_state()
+        for d in range(num_docs):
+            expected = opset_visible_tree(opsets[d].get_patch()["diffs"])
+            actual = tr.decode_visible(keys[d], ops[d], winners[d], values[d])
+            assert actual == expected, f"doc {d}: {actual} != {expected}"
