@@ -93,7 +93,7 @@ def sharded_visible_state(mesh: Mesh):
     s_shard = state_sharding(mesh)
     row = NamedSharding(mesh, P("dp", "sp"))
     rep = NamedSharding(mesh, P())
-    out = (row, row, row, row)
+    out = (row, row, row, row, row)
 
     def impl(state, actor_rank):
         return _visible_state_impl(state, remap_opid_actors(state.op, actor_rank))

@@ -201,26 +201,26 @@ def batched_apply_ops(state: BatchedDocState, changes: ChangeOpsBatch) -> Batche
 def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     """Computes per-row visibility for one document.
 
-    Returns (key, op, winner, value_total): `winner[i]` is true iff row i is
-    the winning visible set op of its key (the visible set op with the
-    greatest Lamport opId, apply_patch.js:33-42). `value_total[i]` at a
-    winner row is the winner's value plus the sum of live increments of its
-    key (counter accumulation, new.js:937-965).
+    Returns (key, op, visible, winner, value_total):
+    - `visible[i]`: row i is a visible set op (no non-increment successor) —
+      the rows that populate a conflict map (new.js:112-130);
+    - `winner[i]`: row i is the winning visible set op of its key (the
+      visible set op with the greatest Lamport opId, apply_patch.js:33-42);
+    - `value_total[i]` at a visible row: the row's value plus the sum of
+      live increments targeting *that row* (per-target succ accumulation,
+      new.js:937-965), so conflicting counters each carry their own total.
 
     `cmp` is the comparison opId per row: the packed opId itself, or its
     actor bits remapped to lexicographic actor ranks (rga.remap_opid_actors)
     so counter ties break on the actor *string* like the reference
     (new.js:146, apply_patch.js:33).
 
-    Per-key reductions exploit the sorted key column: run boundaries come
-    from binary search, so segmented sums/maxes reduce to one plain cumsum
-    and one plain cummax -- no scatters (TPU scatters serialise) and no
-    deep scan graphs (compile-time friendly). The segmented max rides a
-    single global cummax by packing the (ascending) key into the high bits:
-    a later run's rows always dominate earlier runs, so evaluating the
-    prefix max at the run's end yields the run's own max (or an
-    earlier-keyed value iff the run has no candidate, which then matches
-    no row of the run).
+    Per-key reductions exploit the sorted key column: a run ends where the
+    key differs from its right neighbour; each row's run-end index is one
+    suffix min over the end positions, and the segmented max rides a single
+    global cummax by packing the (ascending) key into the high bits — no
+    scatters in the winner path (TPU scatters serialise) and no deep scan
+    graphs.
     """
     n = key.shape[0]
     is_real = key != PAD_KEY
@@ -228,16 +228,8 @@ def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     is_inc = is_real & (action == ACTION_INC)
     visible_set = is_set & ~over
 
-    # run boundaries of each row's key: the key column is sorted, so a run
-    # starts where the key differs from its left neighbour and ends where it
-    # differs from its right neighbour. Each row's nearest boundary index is
-    # then recovered with one prefix max / suffix min over the boundary
-    # positions -- O(n) scans instead of searchsorted's O(n log n) binary
-    # search passes.
     iota = jnp.arange(n, dtype=jnp.int32)
-    is_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), key[1:] != key[:-1]])
     is_end = jnp.concatenate([key[:-1] != key[1:], jnp.ones((1,), jnp.bool_)])
-    run_start = jax.lax.cummax(jnp.where(is_start, iota, -1))
     run_end = jax.lax.cummin(
         jnp.where(is_end, iota, jnp.iinfo(jnp.int32).max), reverse=True
     )
@@ -262,12 +254,12 @@ def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     target_live = (mkey[tpos] == target_mkey) & ~over[tpos]
     inc_live = is_inc & target_live
 
-    # per-run increment total via prefix sums evaluated at run boundaries
+    # per-target accumulation: each live inc adds its value onto the row it
+    # names in pred (a segment-sum scatter-add over target positions).
     inc_vals = jnp.where(inc_live, value, 0)
-    csum = jnp.concatenate([jnp.zeros((1,), inc_vals.dtype), jnp.cumsum(inc_vals)])
-    inc_total = csum[run_end + 1] - csum[run_start]
-    value_total = jnp.where(winner, value + inc_total, 0)
-    return key, op, winner, value_total
+    row_inc = jax.ops.segment_sum(inc_vals, tpos, num_segments=n)
+    value_total = jnp.where(visible_set, value + row_inc, 0)
+    return key, op, visible_set, winner, value_total
 
 
 @jax.jit
@@ -281,7 +273,8 @@ def _batched_visible_state_cmp(state: BatchedDocState, cmp):
 def batched_visible_state(state: BatchedDocState, actor_rank=None):
     """Materialises the visible state of every document: the device-side
     equivalent of documentPatch (new.js:1604). Returns per-row
-    (key, op, winner, value_total) arrays of shape [docs, capacity].
+    (key, op, visible, winner, value_total) arrays of shape
+    [docs, capacity].
 
     `actor_rank` (int32[A], actor intern index -> lexicographic rank) makes
     counter-tied conflicts resolve on the actor id string exactly like the

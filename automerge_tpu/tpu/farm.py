@@ -1,0 +1,643 @@
+"""Batched document farm: the backend contract over the device merge engine.
+
+`TpuDocFarm` manages N documents and speaks the reference backend's
+applyChanges -> patch protocol (backend/backend.js:27, new.js:1796) for all
+of them at once: binary changes in, reference-format patches out, with the
+merge + visibility/conflict computation running as one batched device
+program per call (engine.batched_apply_ops / batched_visible_state).
+
+Division of labour:
+- **Host**: change decoding (columnar -> op dicts), the causal gate
+  (dedup by hash, dependency check, per-actor seq contiguity — the port of
+  new.js:1550-1597), op transcoding to dense rows, and patch *assembly*
+  from device-computed visibility.
+- **Device**: the op-table merge (succ/overwrite resolution) and the
+  visibility/winner/counter-total computation for every document in the
+  batch — the work the reference does per-doc in mergeDocChangeOps
+  (new.js:1052) and updatePatchProperty (new.js:884).
+
+Patch assembly reproduces the reference's patch shape exactly (verified by
+the differential suite in tests/test_farm.py): per touched key a conflict
+map of every visible op {opId: valueDiff}, child objects linked through
+parent props up to the root (setupPatches, new.js:1461), counters emitted
+with per-target accumulated totals (new.js:937-965), deleted keys as empty
+conflict maps.
+
+Map-family documents (maps, tables, counters, nested trees) are supported;
+list/text objects route to the RGA text engine (text_engine.py) and are not
+yet wired into the farm.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from ..columnar import decode_change
+from ..common import utf16_key
+from .engine import (
+    ACTION_DEL,
+    ACTION_INC,
+    ACTION_SET,
+    ACTOR_BITS,
+    ACTOR_MASK,
+    BatchedMapEngine,
+    PAD_KEY,
+    changes_from_numpy,
+)
+from .transcode import _Interner, actor_rank_table
+
+
+class ValueCell(NamedTuple):
+    """Interned scalar payload of a set op: raw value + optional datatype."""
+
+    value: object
+    datatype: object
+
+
+class ChildObj(NamedTuple):
+    """Interned value marking 'this key holds the object with this id'."""
+
+    object_id: str
+
+
+_ROOT_META = {"parentObj": None, "parentKey": None, "type": "map"}
+
+
+def _empty_object_patch(object_id, type_):
+    if type_ in ("list", "text"):
+        return {"objectId": object_id, "type": type_, "edits": []}
+    return {"objectId": object_id, "type": type_, "props": {}}
+
+
+class TpuDocFarm:
+    """N documents, one device engine. See module docstring."""
+
+    def __init__(self, num_docs: int, capacity: int = 1024):
+        self.num_docs = num_docs
+        self.engine = BatchedMapEngine(num_docs, capacity)
+        # interners are shared across the batch: actor ids, (objectId, key)
+        # slots and scalar values are global tables, document state is not
+        self.actors = _Interner()
+        self.slots = _Interner()
+        self.values = _Interner()
+        # per-document host state
+        self.object_meta = [{"_root": dict(_ROOT_META)} for _ in range(num_docs)]
+        self.clock = [{} for _ in range(num_docs)]
+        self.heads = [[] for _ in range(num_docs)]
+        self.queue = [[] for _ in range(num_docs)]
+        self.changes = [[] for _ in range(num_docs)]  # raw change buffers
+        self.change_index_by_hash = [{} for _ in range(num_docs)]
+        self.hashes_by_actor = [{} for _ in range(num_docs)]
+        self.max_op = [0] * num_docs
+        self.counter_ops = [set() for _ in range(num_docs)]  # packed opids
+        # max inc opId per counter (Lamport tuple) — gates counter emission
+        self.inc_max = [{} for _ in range(num_docs)]
+        # counters named by a multi-pred inc as a non-highest pred: the
+        # reference registers each inc to its highest-opId pred only
+        # (counterStates overwrite, new.js:621-628), so these counters'
+        # succ lists never drain and they never emit
+        self.starved = [set() for _ in range(num_docs)]
+        # per-(obj, key) cache of 'visible values at last walk' (the
+        # reference's objectMeta children map, new.js:426) used by the
+        # setupPatches ancestor-linking walk
+        self.children = [{} for _ in range(num_docs)]
+
+    # ------------------------------------------------------------------ #
+    # transcoding
+
+    def _pack_opid(self, op_id: str) -> int:
+        ctr, actor = op_id.split("@")
+        return (int(ctr) << ACTOR_BITS) | self.actors.intern(actor)
+
+    def _opid_str(self, packed: int) -> str:
+        return f"{packed >> ACTOR_BITS}@{self.actors.lookup(packed & ACTOR_MASK)}"
+
+    def _op_rows(self, d: int, op: dict, ctr: int, actor: str):
+        """Dense rows for one decoded backend-form op (columnar.decode_ops
+        output). Multi-pred ops emit one primary row plus marker rows (one
+        per extra pred) that exist purely to record the extra succ edges;
+        markers share the primary's opId and sort directly after it (stable
+        sort + left-searchsorted), so opId lookups always hit the primary."""
+        if "key" not in op or op.get("insert") or op.get("elemId") is not None:
+            raise NotImplementedError(
+                "list/text ops are handled by the RGA text engine, not the farm"
+            )
+        obj, key = op["obj"], op["key"]
+        if obj not in self.object_meta[d]:
+            raise ValueError(f"op for missing object {obj}")
+        slot = self.slots.intern((obj, key))
+        packed = (ctr << ACTOR_BITS) | self.actors.intern(actor)
+        preds = [self._pack_opid(p) for p in op.get("pred", ())]
+        action = op["action"]
+        if action == "set":
+            datatype = op.get("datatype")
+            if datatype == "counter":
+                self.counter_ops[d].add(packed)
+                value = int(op["value"])
+            else:
+                value = self.values.intern(ValueCell(op["value"], datatype))
+            rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
+        elif action in ("makeMap", "makeTable"):
+            child_id = f"{ctr}@{actor}"
+            self.object_meta[d][child_id] = {
+                "parentObj": obj,
+                "parentKey": key,
+                "type": "map" if action == "makeMap" else "table",
+            }
+            value = self.values.intern(ChildObj(child_id))
+            rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
+        elif action == "inc":
+            lam = (ctr, actor)
+            for target in op.get("pred", ()):
+                t = self._pack_opid(target)
+                if t not in self.inc_max[d] or self.inc_max[d][t] < lam:
+                    self.inc_max[d][t] = lam
+            # A multi-pred inc adds its value to only ONE target in the
+            # reference: counterStates[incOp] is overwritten by each walked
+            # counter, so the highest-opId pred wins (new.js:621-628). The
+            # primary row carries the value to preds[-1] (preds are sorted
+            # ascending); the rest get zero-valued inc markers, which keep
+            # the extra counters visible (inc successors never hide,
+            # new.js:937-944) without contributing.
+            rows = [(slot, packed, ACTION_INC, int(op["value"]), preds[-1] if preds else -1)]
+            for extra in preds[:-1]:
+                self.starved[d].add(extra)
+                rows.append((slot, packed, ACTION_INC, 0, extra))
+            return rows
+        elif action == "del":
+            rows = [(slot, packed, ACTION_DEL, 0, preds[0] if preds else -1)]
+        else:
+            raise NotImplementedError(f"op action {action!r} not supported by the farm")
+        for extra in preds[1:]:
+            rows.append((slot, packed, ACTION_DEL, 0, extra))
+        return rows
+
+    def _actor_rank(self):
+        return actor_rank_table(self.actors.table)
+
+    def _lamport(self, packed: int):
+        return (packed >> ACTOR_BITS, self.actors.lookup(packed & ACTOR_MASK))
+
+    # ------------------------------------------------------------------ #
+    # run segmentation and patch cutoffs
+    #
+    # The sequential merge (mergeDocChangeOps, new.js:1052) walks doc ops of
+    # a key only while that key's change ops are pending; once the run's
+    # batching advances to a later key, the rest of the key's ops are copied
+    # without patch emission. Each walk also RESETS the key's conflict map
+    # (first_op => props[key] = {}, new.js:1000). Net effect: a touched
+    # key's final conflict map equals the LAST touching run's walk — the
+    # final visible ops of the key whose opId is <= that run's cutoff for
+    # the key (+inf when the key is the run's last batch, because the stale
+    # change-op comparison keeps the walk going to the end of the key run).
+    # Counters additionally require every inc successor to be walked
+    # (new.js:1124-1133), i.e. max inc opId <= cutoff.
+
+    _INF = (float("inf"), "")
+
+    def _compute_cutoffs(self, d, applied_ops):
+        """applied_ops: in-order [(op_dict, ctr, actor, gate_batch)] of every
+        map-family op applied this call. Returns {slot: lamport-cutoff}
+        where later touching runs overwrite earlier ones. Runs may span
+        consecutive changes of one actor within a causal gate batch (the
+        reference's change_state walks all ops of a batch in sequence) but
+        never a gate-batch boundary (each batch is a separate merge pass,
+        new.js:1816-1822)."""
+        cutoffs = {}
+        run = None  # {"actor", "obj", "last_key", "batches": [(key, release)]}
+
+        def close(run):
+            if run is None:
+                return
+            last = len(run["batches"]) - 1
+            for i, (key, release) in enumerate(run["batches"]):
+                slot = self.slots.intern((run["obj"], key))
+                cutoffs[slot] = self._INF if i == last else release
+
+        last_batch = None
+        for op, ctr, actor, gate_batch in applied_ops:
+            if gate_batch != last_batch:
+                close(run)
+                run = None
+                last_batch = gate_batch
+            key = op["key"]
+            obj = op["obj"]
+            lam = (ctr, actor)
+            preds = []
+            for p in op.get("pred", ()):
+                pctr, pactor = p.split("@")
+                preds.append((int(pctr), pactor))
+            # a del op leaves the pending batch when its last pred is walked
+            release = max(preds, default=lam) if op["action"] == "del" else lam
+
+            if run is not None and run["actor"] == actor and run["obj"] == obj:
+                bkey, brel = run["batches"][-1]
+                overwrite = any(p in run["batch_ids"] for p in preds)
+                if key == bkey and not overwrite:
+                    run["batches"][-1] = (bkey, max(brel, release))
+                    run["batch_ids"].add(lam)
+                    run["last_key"] = key
+                    continue
+                if utf16_key(run["last_key"]) < utf16_key(key):
+                    run["batches"].append((key, release))
+                    run["batch_ids"] = {lam}
+                    run["last_key"] = key
+                    continue
+            close(run)
+            run = {"actor": actor, "obj": obj, "last_key": key,
+                   "batches": [(key, release)], "batch_ids": {lam}}
+        close(run)
+        return cutoffs
+
+    # ------------------------------------------------------------------ #
+    # causal gate (port of the applyChanges function, new.js:1550)
+
+    def _gate_round(self, d: int, pending):
+        heads = set(self.heads[d])
+        clock = dict(self.clock[d])
+        round_hashes = set()
+        applied, enqueued = [], []
+        for change in pending:
+            if (
+                change["hash"] in self.change_index_by_hash[d]
+                or change["hash"] in round_hashes
+            ):
+                continue
+            expected_seq = clock.get(change["actor"], 0) + 1
+            ready = all(
+                dep in self.change_index_by_hash[d] or dep in round_hashes
+                for dep in change["deps"]
+            )
+            if not ready:
+                enqueued.append(change)
+            elif change["seq"] < expected_seq:
+                raise ValueError(
+                    f"Reuse of sequence number {change['seq']} for actor {change['actor']}"
+                )
+            elif change["seq"] > expected_seq:
+                raise ValueError(
+                    f"Skipped sequence number {expected_seq} for actor {change['actor']}"
+                )
+            else:
+                clock[change["actor"]] = change["seq"]
+                round_hashes.add(change["hash"])
+                for dep in change["deps"]:
+                    heads.discard(dep)
+                heads.add(change["hash"])
+                applied.append(change)
+        if applied:
+            self.heads[d] = sorted(heads)
+            self.clock[d] = clock
+        return applied, enqueued
+
+    # ------------------------------------------------------------------ #
+    # the batched applyChanges step
+
+    def apply_changes(self, per_doc_buffers, is_local=False):
+        """Applies binary changes to every document (one device merge for
+        the whole batch) and returns one reference-format patch per doc.
+        `per_doc_buffers` is a list of num_docs lists of change buffers."""
+        assert len(per_doc_buffers) == self.num_docs
+        per_doc_rows = [[] for _ in range(self.num_docs)]
+        applied_ops = [[] for _ in range(self.num_docs)]
+        touched_objects = [set() for _ in range(self.num_docs)]
+        applied_changes = [[] for _ in range(self.num_docs)]
+
+        for d, buffers in enumerate(per_doc_buffers):
+            decoded = []
+            for buffer in buffers:
+                change = decode_change(buffer)
+                change["buffer"] = bytes(buffer)
+                decoded.append(change)
+            pending = decoded + self.queue[d] if self.queue[d] else decoded
+            gate_batch = 0
+            while True:
+                applied, pending = self._gate_round(d, pending)
+                if not applied:
+                    break
+                gate_batch += 1
+                for change in applied:
+                    ctr = change["startOp"]
+                    for op in change["ops"]:
+                        rows = self._op_rows(d, op, ctr, change["actor"])
+                        per_doc_rows[d].extend(rows)
+                        applied_ops[d].append((op, ctr, change["actor"], gate_batch))
+                        touched_objects[d].add(op["obj"])
+                        ctr += 1
+                    self.max_op[d] = max(self.max_op[d], ctr - 1)
+                    applied_changes[d].append(change)
+                    # commit immediately so later gate rounds (and later
+                    # calls) see this hash as a satisfied dependency
+                    self.changes[d].append(change["buffer"])
+                    self.change_index_by_hash[d][change["hash"]] = (
+                        len(self.changes[d]) - 1
+                    )
+                    by_actor = self.hashes_by_actor[d].setdefault(change["actor"], [])
+                    while len(by_actor) < change["seq"]:
+                        by_actor.append(None)
+                    by_actor[change["seq"] - 1] = change["hash"]
+                if not pending:
+                    break
+            self.queue[d] = pending
+
+        # one device merge for the whole batch
+        width = max((len(r) for r in per_doc_rows), default=0)
+        if width > 0:
+            keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
+            ops = np.zeros((self.num_docs, width), np.int64)
+            actions = np.zeros((self.num_docs, width), np.int32)
+            values = np.zeros((self.num_docs, width), np.int64)
+            preds = np.full((self.num_docs, width), -1, np.int64)
+            for d, rows in enumerate(per_doc_rows):
+                for i, (slot, packed, action, value, pred) in enumerate(rows):
+                    keys[d, i] = slot
+                    ops[d, i] = packed
+                    actions[d, i] = action
+                    values[d, i] = value
+                    preds[d, i] = pred
+            self.engine.apply_batch(
+                changes_from_numpy(keys, ops, actions, values, preds)
+            )
+
+        # no-op deliveries (all queued or duplicates) need no device work
+        vis = self._read_visibility() if width > 0 else None
+        patches = []
+        for d in range(self.num_docs):
+            cutoffs = self._compute_cutoffs(d, applied_ops[d])
+            diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
+            patch = {
+                "maxOp": self.max_op[d],
+                "clock": self.clock[d],
+                "deps": self.heads[d],
+                "pendingChanges": len(self.queue[d]),
+                "diffs": diffs,
+            }
+            if is_local and len(per_doc_buffers[d]) == 1 and applied_changes[d]:
+                patch["actor"] = applied_changes[d][0]["actor"]
+                patch["seq"] = applied_changes[d][0]["seq"]
+            patches.append(patch)
+        return patches
+
+    # ------------------------------------------------------------------ #
+    # patch assembly from device visibility
+
+    def _read_visibility(self):
+        keys, ops, visible, _winners, totals = self.engine.visible_state(
+            actor_rank=self._actor_rank() if self.actors.table else None
+        )
+        return (
+            np.asarray(keys),
+            np.asarray(ops),
+            np.asarray(visible),
+            np.asarray(totals),
+            np.asarray(self.engine.state.action),
+        )
+
+    def _slot_rows(self, d, vis, slot):
+        """All walkable rows of one slot in ascending opId order (the row
+        sort order): [(packed, action, visible, total)]. Deletion rows and
+        multi-pred marker rows are skipped — the reference stores deletions
+        only as succ entries, so its walk never visits them."""
+        keys, ops, visible, totals, actions = vis
+        row_keys = keys[d]
+        lo = np.searchsorted(row_keys, slot, side="left")
+        hi = np.searchsorted(row_keys, slot, side="right")
+        out = []
+        for i in range(lo, hi):
+            if actions[d, i] == ACTION_DEL:
+                continue
+            out.append(
+                (int(ops[d, i]), int(actions[d, i]), bool(visible[d, i]),
+                 int(totals[d, i]))
+            )
+        # the engine table sorts by actor intern index; the reference walk
+        # order ties same-counter ops on the actor id string
+        out.sort(key=lambda r: self._lamport(r[0]))
+        return out
+
+    def _visible_rows(self, d, vis, slot):
+        """[(packed_opid, value_total)] of visible set rows for one slot."""
+        return [
+            (packed, total)
+            for packed, action, visible, total in self._slot_rows(d, vis, slot)
+            if visible and action == ACTION_SET
+        ]
+
+    def _value_diff(self, d, patches, packed, total):
+        """The valueDiff for one visible row (updatePatchProperty's values,
+        new.js:884-1033)."""
+        if packed in self.counter_ops[d]:
+            return {"type": "value", "datatype": "counter", "value": total}
+        cell = self.values.lookup(total)
+        if isinstance(cell, ChildObj):
+            child = cell.object_id
+            if child not in patches:
+                patches[child] = _empty_object_patch(
+                    child, self.object_meta[d][child]["type"]
+                )
+            return patches[child]
+        diff = {"type": "value", "value": cell.value}
+        if cell.datatype is not None:
+            diff["datatype"] = cell.datatype
+        return diff
+
+    def _ensure_patch(self, d, patches, object_id):
+        if object_id not in patches:
+            patches[object_id] = _empty_object_patch(
+                object_id, self.object_meta[d][object_id]["type"]
+            )
+        return patches[object_id]
+
+    def _emitted_rows(self, d, rows, cutoff):
+        """The visible set rows (from _slot_rows) the sequential walk would
+        have emitted under `cutoff` (see _compute_cutoffs): opId <= cutoff,
+        counters only when every inc successor was walked too."""
+        out = []
+        for packed, action, visible, total in rows:
+            if not visible or action != ACTION_SET:
+                continue
+            if self._lamport(packed) > cutoff:
+                continue
+            if packed in self.counter_ops[d] and not self._counter_emits(
+                d, packed, cutoff
+            ):
+                continue
+            out.append((packed, total))
+        return out
+
+    def _counter_emits(self, d, packed, cutoff):
+        """A counter emits only when its succ list drains during the walk:
+        every inc targeting it must be walked (<= cutoff) and actually
+        registered to it (not to a higher-opId conflicting counter)."""
+        if packed in self.starved[d]:
+            return False
+        max_inc = self.inc_max[d].get(packed)
+        return max_inc is None or max_inc <= cutoff
+
+    def _cache_spec(self, d, packed, total):
+        """Children-cache entry for one emitted row: the reference caches
+        raw decoded values (counters with inc successors are filtered out by
+        the caller, so `total` here is the raw value) and object stubs
+        (new.js:426, updatePatchProperty's `values`)."""
+        if packed in self.counter_ops[d]:
+            return {"type": "value", "value": total, "datatype": "counter"}
+        cell = self.values.lookup(total)
+        if isinstance(cell, ChildObj):
+            return ("child", cell.object_id)
+        diff = {"type": "value", "value": cell.value}
+        if cell.datatype is not None:
+            diff["datatype"] = cell.datatype
+        return diff
+
+    def _update_children_cache(self, d, slot, cutoff, rows):
+        """Replays the walk's per-op cache updates for one slot.
+
+        The reference re-evaluates `hasChild or prev_children` at EVERY
+        walked op, reading the cache live (new.js:923-935): once a walk
+        shrinks the cache to empty, later ops of the same walk can no longer
+        update it (the gate reads the now-empty cache), so the final cache
+        is order-dependent. Counters with inc successors never enter
+        visibleOps (their succNum > 0), and inc ops enter visibleOps but
+        not the cached values."""
+        cache = self.children[d].get(slot)
+        specs = []  # cached (opId, spec) accumulated in walk order
+        has_child = False
+        updated = False
+        for packed, action, visible, total in rows:
+            if self._lamport(packed) > cutoff:
+                break  # rows are in ascending opId order; the rest unwalked
+            if action == ACTION_SET:
+                ref_overwritten = (not visible) or (
+                    packed in self.counter_ops[d] and packed in self.inc_max[d]
+                )
+                if not ref_overwritten:
+                    spec = self._cache_spec(d, packed, total)
+                    specs.append((self._opid_str(packed), spec))
+                    has_child = has_child or isinstance(spec, tuple)
+            if has_child or cache:
+                cache = dict(specs)
+                updated = True
+        if updated:
+            self.children[d][slot] = cache
+
+    def _build_diffs(self, d, vis, cutoffs, touched_objects):
+        patches = {"_root": _empty_object_patch("_root", "map")}
+
+        for slot in sorted(cutoffs):
+            obj, key = self.slots.lookup(slot)
+            if obj not in self.object_meta[d]:
+                continue
+            patch = self._ensure_patch(d, patches, obj)
+            rows = self._slot_rows(d, vis, slot)
+            emitted = self._emitted_rows(d, rows, cutoffs[slot])
+            # each walk resets the key's conflict map (new.js:1000)
+            props = patch["props"][key] = {}
+            for packed, total in emitted:
+                props[self._opid_str(packed)] = self._value_diff(
+                    d, patches, packed, total
+                )
+            self._update_children_cache(d, slot, cutoffs[slot], rows)
+
+        # link touched objects up to the root (setupPatches, new.js:1461)
+        for object_id in sorted(touched_objects):
+            meta = self.object_meta[d].get(object_id)
+            if meta is None:
+                continue
+            child_meta = None
+            patch_exists = False
+            while True:
+                values = None
+                if child_meta is not None:
+                    slot = self.slots.intern((object_id, child_meta["parentKey"]))
+                    values = self.children[d].get(slot) or {}
+                has_children = child_meta is not None and len(values) > 0
+                self._ensure_patch(d, patches, object_id)
+                if child_meta is not None and has_children:
+                    props = patches[object_id]["props"].setdefault(
+                        child_meta["parentKey"], {}
+                    )
+                    for op_id, spec in values.items():
+                        if op_id in props:
+                            patch_exists = True
+                        elif isinstance(spec, tuple):  # ("child", id)
+                            child = spec[1]
+                            if child not in patches:
+                                patches[child] = _empty_object_patch(
+                                    child, self.object_meta[d][child]["type"]
+                                )
+                            props[op_id] = patches[child]
+                        else:
+                            props[op_id] = spec
+                if (
+                    patch_exists
+                    or not meta["parentObj"]
+                    or (child_meta is not None and not has_children)
+                ):
+                    break
+                child_meta = dict(meta, opId=object_id)
+                object_id = meta["parentObj"]
+                meta = self.object_meta[d][object_id]
+
+        return patches["_root"]
+
+    # ------------------------------------------------------------------ #
+    # whole-document patch (getPatch, new.js:2052)
+
+    def get_patch(self, d: int):
+        vis = self._read_visibility()
+        keys = vis[0][d]
+        patches = {"_root": _empty_object_patch("_root", "map")}
+        slots_here = sorted({int(s) for s in keys if s != PAD_KEY})
+        for slot in slots_here:
+            obj, key = self.slots.lookup(slot)
+            if obj not in self.object_meta[d]:
+                continue
+            rows = [
+                (packed, total)
+                for packed, total in self._visible_rows(d, vis, slot)
+                if packed not in self.counter_ops[d]
+                or self._counter_emits(d, packed, self._INF)
+            ]
+            if not rows:
+                continue  # whole-doc patches omit empty props (new.js:1604)
+            patch = self._ensure_patch(d, patches, obj)
+            props = patch["props"].setdefault(key, {})
+            for packed, total in rows:
+                props[self._opid_str(packed)] = self._value_diff(
+                    d, patches, packed, total
+                )
+        return {
+            "maxOp": self.max_op[d],
+            "clock": self.clock[d],
+            "deps": self.heads[d],
+            "pendingChanges": len(self.queue[d]),
+            "diffs": patches["_root"],
+        }
+
+    # ------------------------------------------------------------------ #
+    # hash-graph queries (backend.js facade parity)
+
+    def get_heads(self, d: int):
+        return list(self.heads[d])
+
+    def get_all_changes(self, d: int):
+        return list(self.changes[d])
+
+    def get_change_by_hash(self, d: int, hash_: str):
+        index = self.change_index_by_hash[d].get(hash_)
+        return self.changes[d][index] if index is not None else None
+
+    def get_missing_deps(self, d: int, heads=()):
+        """Dependencies needed before queued changes can apply, plus any
+        requested heads we lack (getMissingDeps, new.js:2006)."""
+        missing = set()
+        in_queue = {change["hash"] for change in self.queue[d]}
+        for change in self.queue[d]:
+            for dep in change["deps"]:
+                if dep not in self.change_index_by_hash[d] and dep not in in_queue:
+                    missing.add(dep)
+        for head in heads:
+            if head not in self.change_index_by_hash[d] and head not in in_queue:
+                missing.add(head)
+        return sorted(missing)

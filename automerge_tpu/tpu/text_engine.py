@@ -121,12 +121,9 @@ class BatchedTextEngine:
     def _actor_rank(self) -> np.ndarray:
         """Lexicographic rank per actor intern index, padded to a power of
         two so the jitted kernels see few distinct shapes."""
-        n = max(len(self.actors), 1)
-        ranks = np.zeros(_next_pow2(n), np.int32)
-        order = sorted(range(len(self.actors)), key=lambda i: self.actors[i])
-        for rank, i in enumerate(order):
-            ranks[i] = rank
-        return ranks
+        from .transcode import actor_rank_table
+
+        return actor_rank_table(self.actors, pad_to=_next_pow2(max(len(self.actors), 1)))
 
     def _grow_elems(self, needed: int):
         if needed > rga.MAX_ELEMS:
@@ -223,7 +220,7 @@ class BatchedTextEngine:
         (device rank kernel + device visibility)."""
         actor_rank = self._actor_rank()
         ranks = self.document_ranks(actor_rank)
-        keys, _ops, winners, vals = self.engine.visible_state(actor_rank=actor_rank)
+        keys, _ops, _visible, winners, vals = self.engine.visible_state(actor_rank=actor_rank)
         keys = np.asarray(keys)
         winners = np.asarray(winners)
         vals = np.asarray(vals)

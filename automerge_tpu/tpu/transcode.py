@@ -44,6 +44,20 @@ class ChildRef(NamedTuple):
     object_id: str
 
 
+def actor_rank_table(actors, pad_to=None):
+    """int32 table: actor intern index -> lexicographic rank of the actor id
+    string, so packed-opId comparisons tie-break like the reference
+    (new.js:146, apply_patch.js:33). `pad_to` pads the table (ranks repeat
+    the identity for unused slots) so jitted kernels see fewer shapes."""
+    n = len(actors)
+    size = max(pad_to or n, n, 1)
+    ranks = np.arange(size, dtype=np.int32)  # identity for unused slots
+    order = sorted(range(n), key=lambda i: actors[i])
+    for rank, i in enumerate(order):
+        ranks[i] = rank
+    return ranks
+
+
 class _Interner:
     def __init__(self):
         self.table = []

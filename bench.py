@@ -64,7 +64,7 @@ def bench_tpu(num_docs, capacity, rounds, ops_per_round, seed=0):
     start = time.perf_counter()
     for batch in batches:
         state = batched_apply_ops(state, batch)
-    v_keys, v_ops, winners, v_values = batched_visible_state(state)
+    v_keys, v_ops, visible, winners, v_values = batched_visible_state(state)
     jax.block_until_ready((state, winners))
     elapsed = time.perf_counter() - start
 

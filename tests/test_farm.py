@@ -1,0 +1,309 @@
+"""TpuDocFarm differential suite: the batched device backend must emit
+patches byte-equal (as Python dicts) to the sequential reference-parity
+OpSet backend for identical binary change streams — the cross-backend
+pattern of the reference's test/wasm.js, with the farm playing the role of
+the external backend."""
+import random
+
+import pytest
+
+from automerge_tpu.columnar import decode_change_columns, encode_change
+from automerge_tpu.opset import OpSet
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+
+def make_change(actor, seq, start_op, deps, ops):
+    buf = encode_change(
+        {"actor": actor, "seq": seq, "startOp": start_op, "time": 0,
+         "deps": sorted(deps), "ops": ops}
+    )
+    return buf, decode_change_columns(buf)["hash"]
+
+
+def lamport(op_id):
+    ctr, actor = op_id.split("@")
+    return (int(ctr), actor)
+
+
+def visible_index(diffs, obj="_root", out=None, objects=None):
+    """Walks a whole-doc patch diff into {(obj, key): [(opId, diff)]} plus
+    the set of live object ids — the generator's view of current state."""
+    if out is None:
+        out, objects = {}, {"_root": "map"}
+    for key, values in diffs.get("props", {}).items():
+        entries = sorted(values.items(), key=lambda kv: lamport(kv[0]))
+        if entries:
+            out[(obj, key)] = entries
+        for op_id, diff in entries:
+            if isinstance(diff, dict) and "objectId" in diff:
+                objects[diff["objectId"]] = diff["type"]
+                visible_index(diff, diff["objectId"], out, objects)
+    return out, objects
+
+
+class Workload:
+    """Random map-family workload generator with real concurrency: each
+    round snapshots the doc state, creates changes from 1-2 actors against
+    that same snapshot (concurrent siblings), and delivers them with random
+    delay and order."""
+
+    def __init__(self, seed, actors=("aaaaaaaa", "bbbbbbbb", "cccccccc"),
+                 with_counters=True, with_nesting=True, delay_prob=0.25):
+        self.rng = random.Random(seed)
+        self.actors = actors
+        self.with_counters = with_counters
+        self.with_nesting = with_nesting
+        self.delay_prob = delay_prob
+        self.seqs = dict.fromkeys(actors, 0)
+        self.last_hash = dict.fromkeys(actors, None)
+        self.max_op = 0
+        self.in_flight = []  # (due_round, buffer)
+        self.round = 0
+
+    def _ops_against(self, index, objects, n_ops):
+        ops = []
+        for _ in range(n_ops):
+            obj = self.rng.choice(sorted(objects))
+            key = f"k{self.rng.randrange(5)}"
+            entries = index.get((obj, key), [])
+            preds = [op_id for op_id, _ in entries]
+            counter_ids = [
+                op_id for op_id, d in entries
+                if isinstance(d, dict) and d.get("datatype") == "counter"
+            ]
+            roll = self.rng.random()
+            if counter_ids:
+                ops.append({"action": "inc", "obj": obj, "key": key,
+                            "value": self.rng.randrange(1, 10),
+                            "pred": [counter_ids[-1]]})
+            elif self.with_nesting and roll < 0.18:
+                action = "makeMap" if self.rng.random() < 0.7 else "makeTable"
+                ops.append({"action": action, "obj": obj, "key": key, "pred": preds})
+            elif roll < 0.3 and preds:
+                ops.append({"action": "del", "obj": obj, "key": key, "pred": preds})
+            elif self.with_counters and roll < 0.42 and not preds:
+                ops.append({"action": "set", "obj": obj, "key": key,
+                            "datatype": "counter",
+                            "value": self.rng.randrange(50), "pred": []})
+            else:
+                ops.append({"action": "set", "obj": obj, "key": key,
+                            "datatype": "uint",
+                            "value": self.rng.randrange(1000), "pred": preds})
+        return ops
+
+    def next_round(self, oracle: OpSet):
+        """Generates this round's changes against the oracle's current
+        state and returns the buffers due for delivery this round."""
+        self.round += 1
+        index, objects = visible_index(oracle.get_patch()["diffs"])
+        heads = list(oracle.heads)
+        for actor in self.rng.sample(self.actors, self.rng.randrange(1, 3)):
+            self.seqs[actor] += 1
+            start_op = self.max_op + 1
+            ops = self._ops_against(index, objects, self.rng.randrange(1, 4))
+            deps = set(heads)
+            if self.last_hash[actor]:
+                deps.add(self.last_hash[actor])
+            buf, hash_ = make_change(actor, self.seqs[actor], start_op, deps, ops)
+            self.last_hash[actor] = hash_
+            self.max_op = start_op + len(ops) - 1
+            due = self.round + (self.rng.randrange(1, 3)
+                                if self.rng.random() < self.delay_prob else 0)
+            self.in_flight.append((due, buf))
+        due_now = [buf for r, buf in self.in_flight if r <= self.round]
+        self.in_flight = [(r, buf) for r, buf in self.in_flight if r > self.round]
+        self.rng.shuffle(due_now)
+        return due_now
+
+    def drain(self):
+        """All still-undelivered buffers (to flush queues at the end)."""
+        out = [buf for _, buf in self.in_flight]
+        self.in_flight = []
+        self.rng.shuffle(out)
+        return out
+
+
+def run_farm_differential(num_docs, num_rounds, seed, **workload_kw):
+    farm = TpuDocFarm(num_docs, capacity=256)
+    opsets = [OpSet() for _ in range(num_docs)]
+    loads = [Workload(seed + 17 * d, **workload_kw) for d in range(num_docs)]
+
+    # oracle state BEFORE delivery drives generation, so generate first
+    for rnd in range(num_rounds + 3):
+        per_doc = []
+        for d in range(num_docs):
+            if rnd < num_rounds:
+                per_doc.append(loads[d].next_round(opsets[d]))
+            else:
+                per_doc.append(loads[d].drain())
+        expected = [opsets[d].apply_changes(per_doc[d]) for d in range(num_docs)]
+        got = farm.apply_changes(per_doc)
+        for d in range(num_docs):
+            assert got[d] == expected[d], (
+                f"round {rnd} doc {d}:\n  got  {got[d]}\n  want {expected[d]}"
+            )
+
+    for d in range(num_docs):
+        assert farm.get_patch(d) == opsets[d].get_patch(), f"final get_patch doc {d}"
+        assert farm.get_heads(d) == opsets[d].heads
+        assert farm.get_missing_deps(d) == opsets[d].get_missing_deps()
+
+
+class TestFarmBasics:
+    def test_single_set_patch(self):
+        farm = TpuDocFarm(1, capacity=16)
+        ops = [{"action": "set", "obj": "_root", "key": "x",
+                "datatype": "uint", "value": 7, "pred": []}]
+        buf, _h = make_change("aaaaaaaa", 1, 1, [], ops)
+        opset = OpSet()
+        expected = opset.apply_changes([buf])
+        (got,) = farm.apply_changes([[buf]])
+        assert got == expected
+
+    def test_queued_change_waits_for_deps(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        ops1 = [{"action": "set", "obj": "_root", "key": "x",
+                 "datatype": "uint", "value": 1, "pred": []}]
+        buf1, h1 = make_change("aaaaaaaa", 1, 1, [], ops1)
+        ops2 = [{"action": "set", "obj": "_root", "key": "x",
+                 "datatype": "uint", "value": 2, "pred": ["1@aaaaaaaa"]}]
+        buf2, _h2 = make_change("aaaaaaaa", 2, 2, [h1], ops2)
+
+        expected2 = opset.apply_changes([buf2])
+        (got2,) = farm.apply_changes([[buf2]])
+        assert got2 == expected2
+        assert got2["pendingChanges"] == 1
+        assert farm.get_missing_deps(0) == [h1]
+
+        expected1 = opset.apply_changes([buf1])
+        (got1,) = farm.apply_changes([[buf1]])
+        assert got1 == expected1
+        assert got1["pendingChanges"] == 0
+
+    def test_duplicate_change_is_idempotent(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        ops = [{"action": "set", "obj": "_root", "key": "x",
+                "datatype": "uint", "value": 1, "pred": []}]
+        buf, _h = make_change("aaaaaaaa", 1, 1, [], ops)
+        farm.apply_changes([[buf]])
+        opset.apply_changes([buf])
+        expected = opset.apply_changes([buf])
+        (got,) = farm.apply_changes([[buf]])
+        assert got == expected
+
+    def test_concurrent_conflict_map(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        buf_a, _ = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "k",
+             "datatype": "uint", "value": 1, "pred": []}])
+        buf_b, _ = make_change("bbbbbbbb", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "k",
+             "datatype": "uint", "value": 2, "pred": []}])
+        expected = opset.apply_changes([buf_a, buf_b])
+        (got,) = farm.apply_changes([[buf_a, buf_b]])
+        assert got == expected
+        assert set(got["diffs"]["props"]["k"]) == {"1@aaaaaaaa", "1@bbbbbbbb"}
+
+    def test_multi_pred_conflict_resolution(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        buf_a, ha = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "k",
+             "datatype": "uint", "value": 1, "pred": []}])
+        buf_b, hb = make_change("bbbbbbbb", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "k",
+             "datatype": "uint", "value": 2, "pred": []}])
+        buf_c, _ = make_change("aaaaaaaa", 2, 2, [ha, hb], [
+            {"action": "set", "obj": "_root", "key": "k", "datatype": "uint",
+             "value": 3, "pred": ["1@aaaaaaaa", "1@bbbbbbbb"]}])
+        expected1 = opset.apply_changes([buf_a, buf_b])
+        (got1,) = farm.apply_changes([[buf_a, buf_b]])
+        assert got1 == expected1
+        expected2 = opset.apply_changes([buf_c])
+        (got2,) = farm.apply_changes([[buf_c]])
+        assert got2 == expected2
+        assert list(got2["diffs"]["props"]["k"]) == ["2@aaaaaaaa"]
+
+    def test_nested_make_map_patch(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        buf, _ = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "makeMap", "obj": "_root", "key": "cfg", "pred": []},
+            {"action": "set", "obj": "1@aaaaaaaa", "key": "x",
+             "datatype": "uint", "value": 5, "pred": []}])
+        expected = opset.apply_changes([buf])
+        (got,) = farm.apply_changes([[buf]])
+        assert got == expected
+
+    def test_counter_accumulation_patch(self):
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        buf1, h1 = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "c",
+             "datatype": "counter", "value": 10, "pred": []}])
+        buf2, _ = make_change("aaaaaaaa", 2, 2, [h1], [
+            {"action": "inc", "obj": "_root", "key": "c",
+             "value": 3, "pred": ["1@aaaaaaaa"]}])
+        expected1 = opset.apply_changes([buf1])
+        (got1,) = farm.apply_changes([[buf1]])
+        assert got1 == expected1
+        expected2 = opset.apply_changes([buf2])
+        (got2,) = farm.apply_changes([[buf2]])
+        assert got2 == expected2
+        assert got2["diffs"]["props"]["c"]["1@aaaaaaaa"]["value"] == 13
+
+    def test_multi_pred_inc_on_conflicting_counters(self):
+        """An inc naming two conflicting counters must keep both visible
+        (inc successors never hide) and add its value to the highest-opId
+        target only (counterStates registration, new.js:621-628)."""
+        farm = TpuDocFarm(1, capacity=16)
+        opset = OpSet()
+        buf_a, ha = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "c",
+             "datatype": "counter", "value": 10, "pred": []}])
+        buf_b, hb = make_change("bbbbbbbb", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "c",
+             "datatype": "counter", "value": 100, "pred": []}])
+        buf_c, _ = make_change("cccccccc", 1, 2, [ha, hb], [
+            {"action": "inc", "obj": "_root", "key": "c", "value": 7,
+             "pred": ["1@aaaaaaaa", "1@bbbbbbbb"]}])
+        expected1 = opset.apply_changes([buf_a, buf_b])
+        (got1,) = farm.apply_changes([[buf_a, buf_b]])
+        assert got1 == expected1
+        expected2 = opset.apply_changes([buf_c])
+        (got2,) = farm.apply_changes([[buf_c]])
+        assert got2 == expected2
+        assert farm.get_patch(0) == opset.get_patch()
+
+    def test_seq_reuse_raises(self):
+        farm = TpuDocFarm(1, capacity=16)
+        ops = [{"action": "set", "obj": "_root", "key": "x",
+                "datatype": "uint", "value": 1, "pred": []}]
+        buf1, _ = make_change("aaaaaaaa", 1, 1, [], ops)
+        buf1b, _ = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "set", "obj": "_root", "key": "y",
+             "datatype": "uint", "value": 2, "pred": []}])
+        farm.apply_changes([[buf1]])
+        with pytest.raises(ValueError, match="sequence number"):
+            farm.apply_changes([[buf1b]])
+
+
+class TestFarmDifferential:
+    def test_maps_and_dels(self):
+        run_farm_differential(3, 8, seed=1, with_counters=False,
+                              with_nesting=False)
+
+    def test_counters(self):
+        run_farm_differential(3, 8, seed=2, with_nesting=False)
+
+    def test_nested(self):
+        run_farm_differential(3, 8, seed=3)
+
+    def test_heavy_concurrency_and_delay(self):
+        run_farm_differential(4, 12, seed=4, delay_prob=0.5)
+
+    def test_in_order_stream(self):
+        run_farm_differential(2, 10, seed=5, delay_prob=0.0)

@@ -93,7 +93,7 @@ def run_differential(num_docs, num_rounds, ops_per_round, seed, with_counters=Fa
 
         engine.apply_batch(tr.changes_to_batch(per_doc_rows))
 
-    keys, ops, winners, values = engine.visible_state()
+    keys, ops, _visible, winners, values = engine.visible_state()
     for d in range(num_docs):
         expected = opset_visible_map(opsets[d])
         actual = tr.decode_visible(
@@ -118,7 +118,7 @@ class TestBatchedMapEngine:
             [],
         ])
         engine.apply_batch(batch2)
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc0 = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         doc1 = tr.decode_visible(keys[1], ops[1], winners[1], values[1])
         assert doc0 == {"x": 5, "y": 2}
@@ -131,7 +131,7 @@ class TestBatchedMapEngine:
             [({"action": "set", "obj": "_root", "key": "k", "value": "a", "pred": []}, 1, "aaaaaaaa"),
              ({"action": "set", "obj": "_root", "key": "k", "value": "b", "pred": []}, 1, "bbbbbbbb")],
         ]))
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         assert doc == {"k": "b"}  # same counter, higher actor wins
 
@@ -144,7 +144,7 @@ class TestBatchedMapEngine:
         engine.apply_batch(tr.changes_to_batch([
             [({"action": "del", "obj": "_root", "key": "k", "pred": ["1@aaaaaaaa"]}, 2, "aaaaaaaa")],
         ]))
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         assert doc == {}
 
@@ -162,7 +162,7 @@ class TestBatchedMapEngine:
                "pred": ["1@aaaaaaaa"]}, 2, "bbbbbbbb")],
         ]))
         ck = {tr.slot_id("_root", "c")}
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0], ck)
         assert doc == {"c": 17}
 
@@ -181,7 +181,7 @@ class TestNestedObjects:
             [({"action": "makeMap", "obj": "_root", "key": "child", "pred": []}, 1, "aaaaaaaa"),
              ({"action": "set", "obj": "1@aaaaaaaa", "key": "x", "value": 7, "pred": []}, 2, "aaaaaaaa")],
         ]))
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         assert doc == {"child": {"x": 7}}
 
@@ -194,7 +194,7 @@ class TestNestedObjects:
              ({"action": "set", "obj": "_root", "key": "c", "value": "gone",
                "pred": ["1@aaaaaaaa"]}, 3, "aaaaaaaa")],
         ]))
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         assert doc == {"c": "gone"}
 
@@ -206,7 +206,7 @@ class TestNestedObjects:
              ({"action": "makeMap", "obj": "1@aaaaaaaa", "key": "row-1", "pred": []}, 2, "aaaaaaaa"),
              ({"action": "set", "obj": "2@aaaaaaaa", "key": "name", "value": "ada", "pred": []}, 3, "aaaaaaaa")],
         ]))
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         doc = tr.decode_visible(keys[0], ops[0], winners[0], values[0])
         assert doc == {"t": {"row-1": {"name": "ada"}}}
         assert tr.object_types["1@aaaaaaaa"] == "table"
@@ -262,7 +262,7 @@ class TestNestedObjects:
             # fixed width => one compiled shape across rounds
             engine.apply_batch(tr.changes_to_batch(per_doc_rows, width=4))
 
-        keys, ops, winners, values = engine.visible_state()
+        keys, ops, _visible, winners, values = engine.visible_state()
         for d in range(num_docs):
             expected = opset_visible_tree(opsets[d].get_patch()["diffs"])
             actual = tr.decode_visible(keys[d], ops[d], winners[d], values[d])
