@@ -33,7 +33,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-from ..columnar import decode_change
+from ..columnar import decode_change, decode_change_meta
 from ..common import utf16_key
 from .engine import (
     ACTION_DEL,
@@ -89,6 +89,9 @@ class TpuDocFarm:
         self.changes = [[] for _ in range(num_docs)]  # raw change buffers
         self.change_index_by_hash = [{} for _ in range(num_docs)]
         self.hashes_by_actor = [{} for _ in range(num_docs)]
+        # hash graph (computeHashGraph, new.js:1879) — maintained eagerly
+        self.dependencies_by_hash = [{} for _ in range(num_docs)]
+        self.dependents_by_hash = [{} for _ in range(num_docs)]
         self.max_op = [0] * num_docs
         self.counter_ops = [set() for _ in range(num_docs)]  # packed opids
         # max inc opId per counter (Lamport tuple) — gates counter emission
@@ -337,6 +340,12 @@ class TpuDocFarm:
                     while len(by_actor) < change["seq"]:
                         by_actor.append(None)
                     by_actor[change["seq"] - 1] = change["hash"]
+                    self.dependencies_by_hash[d][change["hash"]] = list(change["deps"])
+                    self.dependents_by_hash[d].setdefault(change["hash"], [])
+                    for dep in change["deps"]:
+                        self.dependents_by_hash[d].setdefault(dep, []).append(
+                            change["hash"]
+                        )
                 if not pending:
                     break
             self.queue[d] = pending
@@ -627,6 +636,43 @@ class TpuDocFarm:
     def get_change_by_hash(self, d: int, hash_: str):
         index = self.change_index_by_hash[d].get(hash_)
         return self.changes[d][index] if index is not None else None
+
+    def get_changes(self, d: int, have_deps):
+        """Changes a replica holding `have_deps` is missing (getChanges,
+        new.js:1913): walk forward from have_deps through the dependents
+        graph; if that cannot reach all heads, fall back to everything not
+        in have_deps' ancestor closure."""
+        if not have_deps:
+            return list(self.changes[d])
+        stack, seen, to_return = [], set(), []
+        for h in have_deps:
+            seen.add(h)
+            successors = self.dependents_by_hash[d].get(h)
+            if successors is None:
+                raise ValueError(f"hash not found: {h}")
+            stack.extend(successors)
+        while stack:
+            h = stack.pop()
+            seen.add(h)
+            to_return.append(h)
+            if not all(dep in seen for dep in self.dependencies_by_hash[d][h]):
+                break
+            stack.extend(self.dependents_by_hash[d][h])
+        if not stack and all(head in seen for head in self.heads[d]):
+            return [self.changes[d][self.change_index_by_hash[d][h]] for h in to_return]
+        stack, seen = list(have_deps), set()
+        while stack:
+            h = stack.pop()
+            if h not in seen:
+                deps = self.dependencies_by_hash[d].get(h)
+                if deps is None:
+                    raise ValueError(f"hash not found: {h}")
+                stack.extend(deps)
+                seen.add(h)
+        return [
+            change for change in self.changes[d]
+            if decode_change_meta(change, True)["hash"] not in seen
+        ]
 
     def get_missing_deps(self, d: int, heads=()):
         """Dependencies needed before queued changes can apply, plus any

@@ -1,0 +1,385 @@
+"""Batched peer-wise sync for a farm of documents.
+
+`SyncFarm` runs the reference sync protocol (backend/sync.js, wire format
+unchanged — see automerge_tpu/sync.py) for many (document, peer) channels at
+once over a `TpuDocFarm`:
+
+- `generate_messages` builds every channel's `have` Bloom filter in ONE
+  batched device program (sync_batch.build_filters) and evaluates every
+  channel's changes-to-send Bloom queries in ONE batched device program
+  (sync_batch.query_filters) — the batched analogue of makeBloomFilter
+  (sync.js:234) and getChangesToSend's containsHash loop (sync.js:246-289).
+- `receive_messages` decodes the messages, applies all channels' changes
+  through the farm's single batched applyChanges, and advances per-channel
+  sharedHeads exactly like receiveSyncMessage (sync.js:420).
+
+Messages are byte-identical to the sequential protocol's (asserted by
+tests/test_sync_farm.py against sync.py driving per-doc backends), so a
+farm can sync against any reference-compatible peer.
+
+Hash-graph traversals (changes since lastSync, dependents closure) stay on
+the host: the graphs are tiny per document and pointer-chasing shaped. The
+device does the bit-parallel work: B filters built and B x C candidate
+probes evaluated per call.
+"""
+from __future__ import annotations
+
+from math import ceil
+
+import numpy as np
+
+from ..columnar import decode_change_meta
+from ..sync import (
+    BITS_PER_ENTRY,
+    decode_sync_message,
+    encode_sync_message,
+    init_sync_state,
+    _advance_heads,
+)
+from .sync_batch import (
+    WORD_BITS,
+    build_filters,
+    filters_to_bytes,
+    hash_to_xyz,
+    pack_hashes,
+    query_filters,
+)
+
+
+def filters_from_bytes(blobs):
+    """Parses wire-format Bloom filters into padded device tensors:
+    (words [B, W] uint32, modulo [B] int32, counts [B] int32). Inverse of
+    filters_to_bytes for same-parameter filters; a zero-entry filter maps
+    to an all-zero row with count 0. The device query kernel hardcodes the
+    default probe count, so filters with other wire parameters must take
+    the host path (see _plan_generate) — passing one here is an error."""
+    from ..sync import NUM_PROBES, BloomFilter
+
+    parsed = [BloomFilter(b) for b in blobs]
+    for p in parsed:
+        if p.num_entries and (
+            p.num_probes != NUM_PROBES or p.num_bits_per_entry != BITS_PER_ENTRY
+        ):
+            raise ValueError(
+                "non-default Bloom parameters require the host BloomFilter path"
+            )
+    num_words = max(
+        (ceil(len(p.bits) / 4) for p in parsed if p.num_entries), default=1
+    ) or 1
+    words = np.zeros((len(parsed), num_words), np.uint32)
+    modulo = np.zeros(len(parsed), np.int32)
+    counts = np.zeros(len(parsed), np.int32)
+    for i, p in enumerate(parsed):
+        if p.num_entries == 0:
+            continue
+        bits = bytes(p.bits)
+        padded = bits + b"\0" * (-len(bits) % 4)
+        row = np.frombuffer(padded, np.uint32)
+        words[i, : row.shape[0]] = row
+        modulo[i] = 8 * len(p.bits)
+        counts[i] = p.num_entries
+    return words, modulo, counts
+
+
+class SyncFarm:
+    """Batched sync driver over a TpuDocFarm. Channels are (doc index,
+    sync_state dict) pairs; sync_state is the reference's shape
+    (initSyncState, sync.js:308) and remains encode/decode-compatible."""
+
+    def __init__(self, farm):
+        self.farm = farm
+
+    @staticmethod
+    def init_state():
+        return init_sync_state()
+
+    # -------------------------------------------------------------- #
+    # generate (sync.js:327, batched)
+
+    def _changes_since(self, d, since_hashes):
+        changes = self.farm.get_changes(d, list(since_hashes))
+        return [decode_change_meta(c, True) for c in changes]
+
+    def generate_messages(self, channels):
+        """channels: [(doc, sync_state)]. Returns [(new_state, bytes|None)]
+        in channel order. All Bloom builds and queries run as one device
+        batch each."""
+        n = len(channels)
+        plans = []
+        for d, state in channels:
+            plans.append(self._plan_generate(d, state))
+
+        # batched `have` filter construction
+        build_idx = [i for i, p in enumerate(plans) if p.get("build_hashes") is not None]
+        if build_idx:
+            xyz, counts = pack_hashes([plans[i]["build_hashes"] for i in build_idx])
+            num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / WORD_BITS)) or 1
+            words, modulo = build_filters(xyz, counts, num_words)
+            blooms = filters_to_bytes(words, modulo, counts)
+            for i, bloom in zip(build_idx, blooms):
+                plans[i]["our_have"] = [
+                    {"lastSync": plans[i]["shared_heads"], "bloom": bloom}
+                ]
+
+        # batched changes-to-send Bloom queries: flatten every channel's
+        # (their-filter, candidate-hash) pairs into one [B, C] query
+        query_idx = [i for i, p in enumerate(plans) if p.get("query") is not None]
+        if query_idx:
+            blobs, cand_lists = [], []
+            for i in query_idx:
+                blobs.append(plans[i]["query"]["bloom"])
+                cand_lists.append(plans[i]["query"]["hashes"])
+            words, modulo, counts = filters_from_bytes(blobs)
+            width = max((len(c) for c in cand_lists), default=1) or 1
+            q = np.zeros((len(blobs), width, 3), np.uint32)
+            for b, hashes in enumerate(cand_lists):
+                for c, h in enumerate(hashes):
+                    q[b, c] = hash_to_xyz(h)
+            contained = np.asarray(query_filters(words, modulo, counts, q))
+            for b, i in enumerate(query_idx):
+                hits = {
+                    h
+                    for c, h in enumerate(cand_lists[b])
+                    if contained[b, c]
+                }
+                plans[i]["bloom_positive"] = hits
+
+        results = []
+        for (d, state), plan in zip(channels, plans):
+            results.append(self._finish_generate(d, state, plan))
+        assert len(results) == n
+        return results
+
+    def _plan_generate(self, d, state):
+        """Host phase 1: everything except the device filter ops."""
+        farm = self.farm
+        shared_heads = state["sharedHeads"]
+        their_heads = state["theirHeads"]
+        their_have = state["theirHave"]
+        their_need = state["theirNeed"]
+        our_heads = farm.get_heads(d)
+        our_need = farm.get_missing_deps(d, their_heads or [])
+        plan = {
+            "shared_heads": shared_heads,
+            "our_heads": our_heads,
+            "our_need": our_need,
+            "our_have": [],
+        }
+
+        if their_heads is None or all(h in their_heads for h in our_need):
+            plan["build_hashes"] = [
+                c["hash"] for c in self._changes_since(d, shared_heads)
+            ]
+
+        if their_have:
+            last_sync = their_have[0]["lastSync"]
+            if not all(farm.get_change_by_hash(d, h) for h in last_sync):
+                plan["reset"] = True
+                return plan
+
+        if (
+            isinstance(their_have, list)
+            and isinstance(their_need, list)
+            and their_have  # have=[] is served from `need` alone (sync.py:183)
+        ):
+            # candidates for the Bloom-negative scan: changes since the
+            # union of the peer's lastSync hashes (sync.js:246)
+            last_sync_hashes = []
+            seen = set()
+            for h in their_have:
+                for hash_ in h["lastSync"]:
+                    if hash_ not in seen:
+                        seen.add(hash_)
+                        last_sync_hashes.append(hash_)
+            metas = self._changes_since(d, last_sync_hashes)
+            plan["candidates"] = metas
+            # one wire filter per have entry; entries beyond [0] — and any
+            # filter with non-default wire parameters, which the device
+            # kernel cannot evaluate — take the host BloomFilter path
+            from ..sync import NUM_PROBES, BloomFilter
+
+            first = BloomFilter(their_have[0]["bloom"])
+            conforming = first.num_entries == 0 or (
+                first.num_probes == NUM_PROBES
+                and first.num_bits_per_entry == BITS_PER_ENTRY
+            )
+            if conforming:
+                plan["query"] = {
+                    "bloom": their_have[0]["bloom"],
+                    "hashes": [m["hash"] for m in metas],
+                }
+                plan["extra_blooms"] = [h["bloom"] for h in their_have[1:]]
+            else:
+                plan["bloom_positive"] = set()
+                plan["extra_blooms"] = [h["bloom"] for h in their_have]
+        return plan
+
+    def _finish_generate(self, d, state, plan):
+        """Host phase 2: reference control flow of generateSyncMessage."""
+        farm = self.farm
+        if plan.get("reset"):
+            msg = {
+                "heads": plan["our_heads"], "need": [],
+                "have": [{"lastSync": [], "bloom": b""}], "changes": [],
+            }
+            return state, encode_sync_message(msg)
+
+        their_have = state["theirHave"]
+        their_need = state["theirNeed"]
+        changes_to_send = []
+        if isinstance(their_have, list) and isinstance(their_need, list):
+            if not their_have:
+                changes_to_send = [
+                    c
+                    for c in (farm.get_change_by_hash(d, h) for h in their_need)
+                    if c is not None
+                ]
+            else:
+                changes_to_send = self._changes_to_send(
+                    d, plan, their_have, their_need
+                )
+
+        our_heads = plan["our_heads"]
+        heads_unchanged = (
+            isinstance(state["lastSentHeads"], list)
+            and our_heads == state["lastSentHeads"]
+        )
+        heads_equal = (
+            isinstance(state["theirHeads"], list)
+            and our_heads == state["theirHeads"]
+        )
+        if heads_unchanged and heads_equal and not changes_to_send:
+            return state, None
+
+        sent_hashes = state["sentHashes"]
+        changes_to_send = [
+            c
+            for c in changes_to_send
+            if not sent_hashes.get(decode_change_meta(c, True)["hash"])
+        ]
+        msg = {
+            "heads": our_heads,
+            "have": plan["our_have"],
+            "need": plan["our_need"],
+            "changes": changes_to_send,
+        }
+        if changes_to_send:
+            sent_hashes = dict(sent_hashes)
+            for change in changes_to_send:
+                sent_hashes[decode_change_meta(change, True)["hash"]] = True
+        new_state = dict(state, lastSentHeads=our_heads, sentHashes=sent_hashes)
+        return new_state, encode_sync_message(msg)
+
+    def _changes_to_send(self, d, plan, their_have, their_need):
+        """Bloom-negative changes + dependents closure + explicit needs
+        (getChangesToSend, sync.js:246), with the containsHash loop already
+        evaluated on device (plan['bloom_positive'])."""
+        from ..sync import BloomFilter
+
+        metas = plan["candidates"]
+        positive = plan.get("bloom_positive") or set()
+        extra = [BloomFilter(b) for b in plan.get("extra_blooms", ())]
+
+        change_hashes = set()
+        dependents = {}
+        to_send = set()
+        for meta in metas:
+            change_hashes.add(meta["hash"])
+            for dep in meta["deps"]:
+                dependents.setdefault(dep, []).append(meta["hash"])
+            missed = meta["hash"] not in positive and all(
+                not bloom.contains_hash(meta["hash"]) for bloom in extra
+            )
+            if missed:
+                to_send.add(meta["hash"])
+
+        stack = list(to_send)
+        while stack:
+            h = stack.pop()
+            for dep in dependents.get(h, []):
+                if dep not in to_send:
+                    to_send.add(dep)
+                    stack.append(dep)
+
+        out = []
+        for h in their_need:
+            to_send.add(h)
+            if h not in change_hashes:
+                change = self.farm.get_change_by_hash(d, h)
+                if change is not None:
+                    out.append(change)
+        for meta in metas:
+            if meta["hash"] in to_send:
+                out.append(meta["change"])
+        return out
+
+    # -------------------------------------------------------------- #
+    # receive (sync.js:420, batched apply)
+
+    def receive_messages(self, channels_msgs):
+        """channels_msgs: [(doc, sync_state, message_bytes)]. Applies every
+        channel's changes through ONE batched farm.applyChanges call (docs
+        repeated across channels fall back to per-channel application to
+        preserve per-message head accounting). Returns
+        [(new_state, patch|None)] in channel order."""
+        farm = self.farm
+        decoded = [decode_sync_message(m) for _, _, m in channels_msgs]
+        docs = [d for d, _, _ in channels_msgs]
+        if len(set(docs)) != len(docs):
+            return [
+                self._receive_one(d, s, msg)
+                for (d, s, _), msg in zip(channels_msgs, decoded)
+            ]
+
+        before = {d: farm.get_heads(d) for d in docs}
+        patches = [None] * farm.num_docs
+        if any(msg["changes"] for msg in decoded):
+            per_doc = [[] for _ in range(farm.num_docs)]
+            for d, msg in zip(docs, decoded):
+                per_doc[d] = list(msg["changes"])
+            patches = farm.apply_changes(per_doc)
+
+        results = []
+        for (d, state, _), msg in zip(channels_msgs, decoded):
+            patch = patches[d] if msg["changes"] else None
+            results.append(self._post_receive(d, state, msg, before[d], patch))
+        return results
+
+    def _receive_one(self, d, state, msg):
+        farm = self.farm
+        before = farm.get_heads(d)
+        patch = None
+        if msg["changes"]:
+            per_doc = [[] for _ in range(farm.num_docs)]
+            per_doc[d] = list(msg["changes"])
+            patch = farm.apply_changes(per_doc)[d]
+        return self._post_receive(d, state, msg, before, patch)
+
+    def _post_receive(self, d, state, msg, before_heads, patch):
+        farm = self.farm
+        shared_heads = state["sharedHeads"]
+        last_sent_heads = state["lastSentHeads"]
+        sent_hashes = state["sentHashes"]
+        if msg["changes"]:
+            shared_heads = _advance_heads(
+                before_heads, farm.get_heads(d), shared_heads
+            )
+        if not msg["changes"] and msg["heads"] == before_heads:
+            last_sent_heads = msg["heads"]
+        known = [h for h in msg["heads"] if farm.get_change_by_hash(d, h)]
+        if len(known) == len(msg["heads"]):
+            shared_heads = msg["heads"]
+            if len(msg["heads"]) == 0:
+                last_sent_heads = []
+                sent_hashes = {}
+        else:
+            shared_heads = sorted(set(known + shared_heads))
+        new_state = {
+            "sharedHeads": shared_heads,
+            "lastSentHeads": last_sent_heads,
+            "theirHave": msg["have"],
+            "theirHeads": msg["heads"],
+            "theirNeed": msg["need"],
+            "sentHashes": sent_hashes,
+        }
+        return new_state, patch

@@ -1,0 +1,220 @@
+"""SyncFarm differential suite: the batched sync driver must produce
+byte-identical messages to the sequential protocol (automerge_tpu/sync.py)
+and converge replica farms exactly like per-doc sync does (the simulated
+two-peer pattern of the reference's test/sync_test.js)."""
+import random
+
+import pytest
+
+from automerge_tpu import backend as Backend
+from automerge_tpu import sync as seq_sync
+from automerge_tpu.columnar import decode_change_columns, encode_change
+from automerge_tpu.tpu.farm import TpuDocFarm
+from automerge_tpu.tpu.sync_farm import SyncFarm, filters_from_bytes
+from automerge_tpu.tpu import sync_batch
+
+
+def make_change(actor, seq, start_op, deps, ops):
+    buf = encode_change(
+        {"actor": actor, "seq": seq, "startOp": start_op, "time": 0,
+         "deps": sorted(deps), "ops": ops}
+    )
+    return buf, decode_change_columns(buf)["hash"]
+
+
+class Replica:
+    """One side of the sync test: a farm of N docs plus N sequential
+    backends fed identical changes, so the batched and sequential sync
+    paths can be compared step by step."""
+
+    def __init__(self, num_docs, actor):
+        self.farm = TpuDocFarm(num_docs, capacity=256)
+        self.sync = SyncFarm(self.farm)
+        self.backends = [Backend.init() for _ in range(num_docs)]
+        self.actor = actor
+        self.seqs = [0] * num_docs
+        self.max_op = [0] * num_docs
+
+    def edit(self, d, rng, n_ops=2):
+        """Applies a random local change to doc d on both representations."""
+        self.seqs[d] += 1
+        start = self.max_op[d] + 1
+        ops = []
+        for i in range(n_ops):
+            ops.append({"action": "set", "obj": "_root",
+                        "key": f"k{rng.randrange(6)}", "datatype": "uint",
+                        "value": rng.randrange(1000), "pred": []})
+        buf, _ = make_change(self.actor, self.seqs[d], start,
+                             self.farm.get_heads(d), ops)
+        self.max_op[d] = start + len(ops) - 1
+        per_doc = [[] for _ in range(self.farm.num_docs)]
+        per_doc[d] = [buf]
+        self.farm.apply_changes(per_doc)
+        self.backends[d], _ = Backend.apply_changes(self.backends[d], [buf])
+
+
+def sync_farms(a, b, num_docs, max_rounds=10, check_bytes=True):
+    """Runs the reference sync driver loop (sync_test.js:15-35) over every
+    doc channel simultaneously, batched on each side, optionally asserting
+    byte-equality against the sequential protocol each step."""
+    a_states = [SyncFarm.init_state() for _ in range(num_docs)]
+    b_states = [SyncFarm.init_state() for _ in range(num_docs)]
+    sa_states = [seq_sync.init_sync_state() for _ in range(num_docs)]
+    sb_states = [seq_sync.init_sync_state() for _ in range(num_docs)]
+
+    for _ in range(max_rounds):
+        out_a = a.sync.generate_messages(
+            [(d, a_states[d]) for d in range(num_docs)]
+        )
+        any_msg = False
+        for d in range(num_docs):
+            a_states[d], msg = out_a[d]
+            if check_bytes:
+                sa_states[d], seq_msg = seq_sync.generate_sync_message(
+                    a.backends[d], sa_states[d]
+                )
+                assert msg == seq_msg, f"A->B message mismatch doc {d}"
+            if msg is None:
+                continue
+            any_msg = True
+            (b_states[d], _patch), = b.sync.receive_messages(
+                [(d, b_states[d], msg)]
+            )
+            if check_bytes:
+                b.backends[d], sb_states[d], _p = seq_sync.receive_sync_message(
+                    b.backends[d], sb_states[d], msg
+                )
+        out_b = b.sync.generate_messages(
+            [(d, b_states[d]) for d in range(num_docs)]
+        )
+        for d in range(num_docs):
+            b_states[d], msg = out_b[d]
+            if check_bytes:
+                sb_states[d], seq_msg = seq_sync.generate_sync_message(
+                    b.backends[d], sb_states[d]
+                )
+                assert msg == seq_msg, f"B->A message mismatch doc {d}"
+            if msg is None:
+                continue
+            any_msg = True
+            (a_states[d], _patch), = a.sync.receive_messages(
+                [(d, a_states[d], msg)]
+            )
+            if check_bytes:
+                a.backends[d], sa_states[d], _p = seq_sync.receive_sync_message(
+                    a.backends[d], sa_states[d], msg
+                )
+        if not any_msg:
+            break
+    return a_states, b_states
+
+
+class TestFiltersFromBytes:
+    def test_round_trip(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        xyz = rng.integers(0, 2**32, size=(4, 9, 3), dtype=np.uint32)
+        counts = np.asarray([9, 4, 0, 1], np.int32)
+        words, modulo = sync_batch.build_filters(xyz, counts, 4)
+        blobs = sync_batch.filters_to_bytes(words, modulo, counts)
+        w2, m2, c2 = filters_from_bytes(blobs)
+        np.testing.assert_array_equal(c2, counts)
+        np.testing.assert_array_equal(m2, np.asarray(modulo))
+        got = sync_batch.query_filters(w2, m2, c2, xyz)
+        want = sync_batch.query_filters(words, modulo, counts, xyz)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSyncFarm:
+    def test_empty_docs_reach_quiescence(self):
+        a = Replica(2, "aaaaaaaa")
+        b = Replica(2, "bbbbbbbb")
+        sync_farms(a, b, 2)
+        for d in range(2):
+            assert a.farm.get_heads(d) == b.farm.get_heads(d) == []
+
+    def test_one_sided_transfer(self):
+        rng = random.Random(1)
+        a = Replica(3, "aaaaaaaa")
+        b = Replica(3, "bbbbbbbb")
+        for d in range(3):
+            for _ in range(3):
+                a.edit(d, rng)
+        sync_farms(a, b, 3)
+        for d in range(3):
+            assert a.farm.get_heads(d) == b.farm.get_heads(d)
+            assert a.farm.get_patch(d) == b.farm.get_patch(d)
+
+    def test_divergent_replicas_converge(self):
+        rng = random.Random(2)
+        a = Replica(4, "aaaaaaaa")
+        b = Replica(4, "bbbbbbbb")
+        # common history first: sync once, then diverge
+        for d in range(4):
+            a.edit(d, rng)
+        sync_farms(a, b, 4)
+        for d in range(4):
+            for _ in range(rng.randrange(1, 4)):
+                a.edit(d, rng)
+            for _ in range(rng.randrange(1, 4)):
+                b.edit(d, rng)
+        sync_farms(a, b, 4)
+        for d in range(4):
+            assert a.farm.get_heads(d) == b.farm.get_heads(d)
+            assert a.farm.get_patch(d)["diffs"] == b.farm.get_patch(d)["diffs"]
+
+    def test_repeated_incremental_rounds(self):
+        rng = random.Random(3)
+        a = Replica(2, "aaaaaaaa")
+        b = Replica(2, "bbbbbbbb")
+        for round_ in range(4):
+            for d in range(2):
+                if rng.random() < 0.8:
+                    a.edit(d, rng)
+                if rng.random() < 0.8:
+                    b.edit(d, rng)
+            sync_farms(a, b, 2)
+        for d in range(2):
+            assert a.farm.get_heads(d) == b.farm.get_heads(d)
+            assert a.farm.get_patch(d)["diffs"] == b.farm.get_patch(d)["diffs"]
+
+    def test_batched_receive_single_call(self):
+        """All docs' messages received in ONE batched receive call."""
+        rng = random.Random(4)
+        num_docs = 3
+        a = Replica(num_docs, "aaaaaaaa")
+        b = Replica(num_docs, "bbbbbbbb")
+        for d in range(num_docs):
+            a.edit(d, rng)
+        a_states = [SyncFarm.init_state() for _ in range(num_docs)]
+        b_states = [SyncFarm.init_state() for _ in range(num_docs)]
+        for _ in range(10):
+            out_a = a.sync.generate_messages(
+                [(d, a_states[d]) for d in range(num_docs)]
+            )
+            batch = []
+            for d in range(num_docs):
+                a_states[d], msg = out_a[d]
+                if msg is not None:
+                    batch.append((d, b_states[d], msg))
+            if not batch:
+                break
+            for (d, _, _), (state, _patch) in zip(
+                batch, b.sync.receive_messages(batch)
+            ):
+                b_states[d] = state
+            out_b = b.sync.generate_messages(
+                [(d, b_states[d]) for d in range(num_docs)]
+            )
+            batch = []
+            for d in range(num_docs):
+                b_states[d], msg = out_b[d]
+                if msg is not None:
+                    batch.append((d, a_states[d], msg))
+            for (d, _, _), (state, _patch) in zip(
+                batch, a.sync.receive_messages(batch)
+            ):
+                a_states[d] = state
+        for d in range(num_docs):
+            assert a.farm.get_heads(d) == b.farm.get_heads(d)
