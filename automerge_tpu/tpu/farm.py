@@ -23,9 +23,14 @@ parent props up to the root (setupPatches, new.js:1461), counters emitted
 with per-target accumulated totals (new.js:937-965), deleted keys as empty
 conflict maps.
 
-Map-family documents (maps, tables, counters, nested trees) are supported;
-list/text objects route to the RGA text engine (text_engine.py) and are not
-yet wired into the farm.
+Map-family keys (maps, tables, counters, nested trees) get reference-exact
+patch parity. List/text objects run through the same device kernels — one
+element table per doc feeds the batched RGA rank kernel (rga.py) for
+document order, and per-element conflict resolution rides the map engine —
+with patches emitted as a sequential diff script (insert/update/remove with
+the reference's multi-insert compaction) between the previously-emitted and
+current visible sequences: state-exact (the frontend materialises the same
+document), though not byte-exact to the sequential walk's edit stream.
 """
 from __future__ import annotations
 
@@ -62,6 +67,13 @@ class ChildObj(NamedTuple):
 
 
 _ROOT_META = {"parentObj": None, "parentKey": None, "type": "map"}
+
+_MAKE_TYPES = {
+    "makeMap": "map",
+    "makeTable": "table",
+    "makeList": "list",
+    "makeText": "text",
+}
 
 
 def _empty_object_patch(object_id, type_):
@@ -105,6 +117,19 @@ class TpuDocFarm:
         # reference's objectMeta children map, new.js:426) used by the
         # setupPatches ancestor-linking walk
         self.children = [{} for _ in range(num_docs)]
+        # list/text element tables (rank-kernel inputs): one forest per doc
+        # spanning ALL of its list objects — per-object document order is
+        # the global RGA preorder filtered by owning object (rga.py)
+        self.elem_capacity = 64
+        self.elem_opid = np.zeros((num_docs, self.elem_capacity), np.int64)
+        self.elem_parent = np.full((num_docs, self.elem_capacity), -1, np.int32)
+        self.num_elems = np.zeros(num_docs, np.int32)
+        self.elem_index = [{} for _ in range(num_docs)]  # elemId -> local idx
+        self.elem_ids = [[] for _ in range(num_docs)]  # local idx -> elemId
+        self.elem_object = [[] for _ in range(num_docs)]  # local idx -> objectId
+        # last emitted visible sequence per list object, for the diff-script
+        # patch emission: objectId -> [(elemId, winner_packed, total)]
+        self.list_cache = [{} for _ in range(num_docs)]
 
     # ------------------------------------------------------------------ #
     # transcoding
@@ -123,9 +148,7 @@ class TpuDocFarm:
         markers share the primary's opId and sort directly after it (stable
         sort + left-searchsorted), so opId lookups always hit the primary."""
         if "key" not in op or op.get("insert") or op.get("elemId") is not None:
-            raise NotImplementedError(
-                "list/text ops are handled by the RGA text engine, not the farm"
-            )
+            return self._list_op_rows(d, op, ctr, actor)
         obj, key = op["obj"], op["key"]
         if obj not in self.object_meta[d]:
             raise ValueError(f"op for missing object {obj}")
@@ -141,14 +164,8 @@ class TpuDocFarm:
             else:
                 value = self.values.intern(ValueCell(op["value"], datatype))
             rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
-        elif action in ("makeMap", "makeTable"):
-            child_id = f"{ctr}@{actor}"
-            self.object_meta[d][child_id] = {
-                "parentObj": obj,
-                "parentKey": key,
-                "type": "map" if action == "makeMap" else "table",
-            }
-            value = self.values.intern(ChildObj(child_id))
+        elif action in _MAKE_TYPES:
+            value = self._register_child(d, obj, key, action, ctr, actor)
             rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
         elif action == "inc":
             lam = (ctr, actor)
@@ -175,6 +192,122 @@ class TpuDocFarm:
         for extra in preds[1:]:
             rows.append((slot, packed, ACTION_DEL, 0, extra))
         return rows
+
+    def _register_child(self, d, obj, parent_key, action, ctr, actor):
+        child_id = f"{ctr}@{actor}"
+        self.object_meta[d][child_id] = {
+            "parentObj": obj,
+            "parentKey": parent_key,
+            "type": _MAKE_TYPES[action],
+        }
+        return self.values.intern(ChildObj(child_id))
+
+    def _grow_elems(self, needed: int):
+        from . import rga
+
+        if needed > rga.MAX_ELEMS:
+            raise ValueError(
+                f"document exceeds {rga.MAX_ELEMS} list elements (incl. "
+                "tombstones): beyond the rank kernel's key-packing range"
+            )
+        while needed > self.elem_capacity:
+            pad = self.elem_capacity
+            self.elem_opid = np.concatenate(
+                [self.elem_opid, np.zeros((self.num_docs, pad), np.int64)], axis=1
+            )
+            self.elem_parent = np.concatenate(
+                [self.elem_parent, np.full((self.num_docs, pad), -1, np.int32)],
+                axis=1,
+            )
+            self.elem_capacity *= 2
+
+    def _list_op_rows(self, d: int, op: dict, ctr: int, actor: str):
+        """Dense rows for one list/text op. Inserts register the element in
+        the doc's forest (parent = the referenced element, -1 for _head) and
+        key all engine rows by the element's id, so per-element conflict
+        resolution rides the same device kernels as map keys; document order
+        comes from the batched RGA rank kernel (rga.py)."""
+        from . import rga
+
+        obj = op["obj"]
+        meta = self.object_meta[d].get(obj)
+        if meta is None:
+            raise ValueError(f"op for missing object {obj}")
+        if meta["type"] not in ("list", "text"):
+            raise ValueError(f"list op for non-list object {obj}")
+        packed = (ctr << ACTOR_BITS) | self.actors.intern(actor)
+        preds = [self._pack_opid(p) for p in op.get("pred", ())]
+        action = op["action"]
+
+        if op.get("insert"):
+            if ctr >= rga.MAX_COUNTER:
+                raise ValueError(
+                    f"op counter {ctr} exceeds the rank kernel's packing range"
+                )
+            elem_id = f"{ctr}@{actor}"
+            ref = op.get("elemId") or "_head"
+            idx = int(self.num_elems[d])
+            self._grow_elems(idx + 1)
+            self.num_elems[d] += 1
+            self.elem_opid[d, idx] = packed
+            if ref == "_head":
+                self.elem_parent[d, idx] = -1
+            else:
+                self.elem_parent[d, idx] = self.elem_index[d][ref]
+            self.elem_index[d][elem_id] = idx
+            self.elem_ids[d].append(elem_id)
+            self.elem_object[d].append(obj)
+            key_elem = elem_id
+        else:
+            key_elem = op["elemId"]
+            if key_elem not in self.elem_index[d]:
+                raise ValueError(f"unknown list element {key_elem}")
+        slot = self.slots.intern((obj, key_elem))
+
+        if action == "set":
+            datatype = op.get("datatype")
+            if datatype == "counter":
+                self.counter_ops[d].add(packed)
+                value = int(op["value"])
+            else:
+                value = self.values.intern(ValueCell(op.get("value"), datatype))
+            rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
+        elif action in _MAKE_TYPES:
+            value = self._register_child(d, obj, key_elem, action, ctr, actor)
+            rows = [(slot, packed, ACTION_SET, value, preds[0] if preds else -1)]
+        elif action == "inc":
+            lam = (ctr, actor)
+            for target in op.get("pred", ()):
+                t = self._pack_opid(target)
+                if t not in self.inc_max[d] or self.inc_max[d][t] < lam:
+                    self.inc_max[d][t] = lam
+            rows = [(slot, packed, ACTION_INC, int(op["value"]),
+                     preds[-1] if preds else -1)]
+            for extra in preds[:-1]:
+                self.starved[d].add(extra)
+                rows.append((slot, packed, ACTION_INC, 0, extra))
+            return rows
+        elif action == "del":
+            rows = [(slot, packed, ACTION_DEL, 0, preds[0] if preds else -1)]
+        else:
+            raise NotImplementedError(f"list op action {action!r}")
+        for extra in preds[1:]:
+            rows.append((slot, packed, ACTION_DEL, 0, extra))
+        return rows
+
+    def _element_ranks(self):
+        """Device RGA document order over every doc's element forest."""
+        from .rga import batched_rga_rank
+        from .text_engine import _next_pow2
+
+        valid = np.arange(self.elem_capacity)[None, :] < self.num_elems[:, None]
+        rank = actor_rank_table(
+            self.actors.table,
+            pad_to=_next_pow2(max(len(self.actors.table), 1)),
+        )
+        return np.asarray(
+            batched_rga_rank(self.elem_parent, self.elem_opid, valid, rank)
+        )
 
     def _actor_rank(self):
         return actor_rank_table(self.actors.table)
@@ -224,6 +357,12 @@ class TpuDocFarm:
                 close(run)
                 run = None
                 last_batch = gate_batch
+            if "key" not in op or op.get("insert") or op.get("elemId") is not None:
+                # list/text op: breaks the map run; list patches are emitted
+                # by the diff-script path, not the cutoff machinery
+                close(run)
+                run = None
+                continue
             key = op["key"]
             obj = op["obj"]
             lam = (ctr, actor)
@@ -371,10 +510,15 @@ class TpuDocFarm:
 
         # no-op deliveries (all queued or duplicates) need no device work
         vis = self._read_visibility() if width > 0 else None
+        ranks = None
+        if vis is not None and int(self.num_elems.max(initial=0)) > 0:
+            ranks = self._element_ranks()
         patches = []
         for d in range(self.num_docs):
             cutoffs = self._compute_cutoffs(d, applied_ops[d])
-            diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
+            diffs = self._build_diffs(
+                d, vis, cutoffs, touched_objects[d], ranks
+            )
             patch = {
                 "maxOp": self.max_op[d],
                 "clock": self.clock[d],
@@ -530,8 +674,82 @@ class TpuDocFarm:
         if updated:
             self.children[d][slot] = cache
 
-    def _build_diffs(self, d, vis, cutoffs, touched_objects):
+    def _visible_sequence(self, d, vis, ranks, obj):
+        """One list object's visible elements in document order:
+        [(elemId, winner_packed, total)] — device ranks give the order,
+        device visibility/winners give each element's surviving value."""
+        n = int(self.num_elems[d])
+        if n == 0:
+            return []
+        order = np.argsort(ranks[d, :n], kind="stable")
+        seq = []
+        for idx in order:
+            idx = int(idx)
+            if self.elem_object[d][idx] != obj:
+                continue
+            elem_id = self.elem_ids[d][idx]
+            slot = self.slots.intern((obj, elem_id))
+            best = None
+            for packed, action, visible, total in self._slot_rows(d, vis, slot):
+                if not visible or action != ACTION_SET:
+                    continue
+                if packed in self.counter_ops[d] and packed in self.starved[d]:
+                    continue
+                if best is None or self._lamport(packed) > self._lamport(best[0]):
+                    best = (packed, total)
+            if best is not None:
+                seq.append((elem_id, best[0], best[1]))
+        return seq
+
+    def _diff_edits(self, d, patches, edits, old_seq, new_seq, edited):
+        """Sequential edit script turning the previously-emitted visible
+        sequence into the current one. RGA never reorders surviving
+        elements, so old and new are subsequences of one document order and
+        a two-pointer identity walk suffices; append_edit applies the
+        reference's multi-insert/remove-count compaction (new.js:747)."""
+        from ..opset import append_edit
+
+        old_ids = {e for e, _, _ in old_seq}
+        new_ids = {e for e, _, _ in new_seq}
+        i = j = index = 0
+        while i < len(old_seq) or j < len(new_seq):
+            if i < len(old_seq) and old_seq[i][0] not in new_ids:
+                append_edit(edits, {"action": "remove", "index": index, "count": 1})
+                edited.add(old_seq[i][0])
+                i += 1
+            elif j < len(new_seq) and new_seq[j][0] not in old_ids:
+                elem_id, packed, total = new_seq[j]
+                append_edit(edits, {
+                    "action": "insert", "index": index, "elemId": elem_id,
+                    "opId": self._opid_str(packed),
+                    "value": self._value_diff(d, patches, packed, total),
+                })
+                edited.add(elem_id)
+                j += 1
+                index += 1
+            else:
+                e_old, w_old, t_old = old_seq[i]
+                e_new, w_new, t_new = new_seq[j]
+                if e_old != e_new:  # defensive: treat as remove (cannot occur
+                    append_edit(edits, {"action": "remove", "index": index,
+                                        "count": 1})  # if RGA order holds
+                    edited.add(e_old)
+                    i += 1
+                    continue
+                if (w_old, t_old) != (w_new, t_new):
+                    append_edit(edits, {
+                        "action": "update", "index": index,
+                        "opId": self._opid_str(w_new),
+                        "value": self._value_diff(d, patches, w_new, t_new),
+                    })
+                    edited.add(e_new)
+                i += 1
+                j += 1
+                index += 1
+
+    def _build_diffs(self, d, vis, cutoffs, touched_objects, ranks=None):
         patches = {"_root": _empty_object_patch("_root", "map")}
+        edited_elems = set()  # elemIds already covered by an edit this call
 
         for slot in sorted(cutoffs):
             obj, key = self.slots.lookup(slot)
@@ -548,6 +766,20 @@ class TpuDocFarm:
                 )
             self._update_children_cache(d, slot, cutoffs[slot], rows)
 
+        # list/text objects: diff-script edits against the last emitted
+        # visible sequence (the RGA structural path; order from the device
+        # rank kernel)
+        for obj in sorted(touched_objects):
+            meta = self.object_meta[d].get(obj)
+            if meta is None or meta["type"] not in ("list", "text"):
+                continue
+            patch = self._ensure_patch(d, patches, obj)
+            new_seq = self._visible_sequence(d, vis, ranks, obj)
+            old_seq = self.list_cache[d].get(obj, [])
+            self._diff_edits(d, patches, patch["edits"], old_seq, new_seq,
+                             edited_elems)
+            self.list_cache[d][obj] = new_seq
+
         # link touched objects up to the root (setupPatches, new.js:1461)
         for object_id in sorted(touched_objects):
             meta = self.object_meta[d].get(object_id)
@@ -556,28 +788,63 @@ class TpuDocFarm:
             child_meta = None
             patch_exists = False
             while True:
+                parent_is_list = (
+                    child_meta is not None
+                    and meta["type"] in ("list", "text")
+                )
                 values = None
-                if child_meta is not None:
+                seq_entry = None
+                if child_meta is not None and not parent_is_list:
                     slot = self.slots.intern((object_id, child_meta["parentKey"]))
                     values = self.children[d].get(slot) or {}
-                has_children = child_meta is not None and len(values) > 0
+                elif parent_is_list:
+                    # the connecting key is a list element: visible iff it
+                    # survives in the current sequence
+                    seq = self.list_cache[d].get(object_id)
+                    if seq is None:
+                        seq = self._visible_sequence(d, vis, ranks, object_id)
+                        self.list_cache[d][object_id] = seq
+                    for pos, (elem_id, packed, total) in enumerate(seq):
+                        if elem_id == child_meta["parentKey"]:
+                            seq_entry = (pos, packed, total)
+                            break
+                has_children = (
+                    child_meta is not None
+                    and (seq_entry is not None if parent_is_list else len(values) > 0)
+                )
                 self._ensure_patch(d, patches, object_id)
                 if child_meta is not None and has_children:
-                    props = patches[object_id]["props"].setdefault(
-                        child_meta["parentKey"], {}
-                    )
-                    for op_id, spec in values.items():
-                        if op_id in props:
+                    if parent_is_list:
+                        if child_meta["parentKey"] in edited_elems:
                             patch_exists = True
-                        elif isinstance(spec, tuple):  # ("child", id)
-                            child = spec[1]
-                            if child not in patches:
-                                patches[child] = _empty_object_patch(
-                                    child, self.object_meta[d][child]["type"]
-                                )
-                            props[op_id] = patches[child]
                         else:
-                            props[op_id] = spec
+                            from ..opset import append_edit
+
+                            pos, packed, total = seq_entry
+                            append_edit(patches[object_id]["edits"], {
+                                "action": "update", "index": pos,
+                                "opId": self._opid_str(packed),
+                                "value": self._value_diff(
+                                    d, patches, packed, total
+                                ),
+                            })
+                            edited_elems.add(child_meta["parentKey"])
+                    else:
+                        props = patches[object_id]["props"].setdefault(
+                            child_meta["parentKey"], {}
+                        )
+                        for op_id, spec in values.items():
+                            if op_id in props:
+                                patch_exists = True
+                            elif isinstance(spec, tuple):  # ("child", id)
+                                child = spec[1]
+                                if child not in patches:
+                                    patches[child] = _empty_object_patch(
+                                        child, self.object_meta[d][child]["type"]
+                                    )
+                                props[op_id] = patches[child]
+                            else:
+                                props[op_id] = spec
                 if (
                     patch_exists
                     or not meta["parentObj"]
@@ -595,12 +862,19 @@ class TpuDocFarm:
 
     def get_patch(self, d: int):
         vis = self._read_visibility()
+        ranks = (
+            self._element_ranks() if int(self.num_elems[d]) > 0 else None
+        )
         keys = vis[0][d]
         patches = {"_root": _empty_object_patch("_root", "map")}
+        list_objects = set()
         slots_here = sorted({int(s) for s in keys if s != PAD_KEY})
         for slot in slots_here:
             obj, key = self.slots.lookup(slot)
             if obj not in self.object_meta[d]:
+                continue
+            if self.object_meta[d][obj]["type"] in ("list", "text"):
+                list_objects.add(obj)
                 continue
             rows = [
                 (packed, total)
@@ -616,6 +890,20 @@ class TpuDocFarm:
                 props[self._opid_str(packed)] = self._value_diff(
                     d, patches, packed, total
                 )
+        # list objects materialise as a full insert script in document
+        # order (the whole-doc scan's edits, new.js:1604)
+        from ..opset import append_edit
+
+        for obj in sorted(list_objects):
+            patch = self._ensure_patch(d, patches, obj)
+            for index, (elem_id, packed, total) in enumerate(
+                self._visible_sequence(d, vis, ranks, obj)
+            ):
+                append_edit(patch["edits"], {
+                    "action": "insert", "index": index, "elemId": elem_id,
+                    "opId": self._opid_str(packed),
+                    "value": self._value_diff(d, patches, packed, total),
+                })
         return {
             "maxOp": self.max_op[d],
             "clock": self.clock[d],

@@ -1,0 +1,263 @@
+"""List/Text support in TpuDocFarm: state-exact differential suite.
+
+The farm's list patches are a sequential diff script (not the reference's
+byte-exact edit stream), so the oracle here is the materialised document:
+both backends' patches drive real frontend documents, which must stay
+identical tree-for-tree every round (the cross-backend doc-equality half of
+the reference's test/wasm.js)."""
+import random
+
+import pytest
+
+from automerge_tpu import frontend as Frontend
+from automerge_tpu.frontend.datatypes import Counter, Table, Text
+from automerge_tpu.columnar import decode_change_columns, encode_change
+from automerge_tpu.opset import OpSet
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+
+def make_change(actor, seq, start_op, deps, ops):
+    buf = encode_change(
+        {"actor": actor, "seq": seq, "startOp": start_op, "time": 0,
+         "deps": sorted(deps), "ops": ops}
+    )
+    return buf, decode_change_columns(buf)["hash"]
+
+
+def to_plain(value):
+    """Recursively strips frontend wrapper types down to plain Python."""
+    if isinstance(value, Text):
+        return [to_plain(v) for v in value]
+    if isinstance(value, Table):
+        return {rid: to_plain(value.by_id(rid)) for rid in value.ids}
+    if isinstance(value, Counter):
+        return ("counter", value.value)
+    if isinstance(value, dict):
+        return {k: to_plain(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [to_plain(v) for v in value]
+    return value
+
+
+def materialize(doc):
+    return to_plain(dict(doc))
+
+
+class ListWorkload:
+    """Random workload over one doc mixing list ops (insert at head /
+    after random element, update, delete) with map keys; tracks enough
+    state to emit causally-valid binary changes."""
+
+    def __init__(self, seed, actors=("aaaaaaaa", "bbbbbbbb")):
+        self.rng = random.Random(seed)
+        self.actors = actors
+        self.seqs = dict.fromkeys(actors, 0)
+        self.last_hash = dict.fromkeys(actors, None)
+        self.max_op = 0
+        # list objects: objectId -> list of live elemIds (host mirror of
+        # RGA positions is NOT tracked; refs are picked from live elems)
+        self.lists = {}
+        self.list_keys = {}  # objectId -> root key
+        self.elem_winner = {}  # (obj, elemId) -> winning opId
+        self.map_winner = {}  # key -> opId
+
+    def _new_change(self, ops_builder):
+        actor = self.rng.choice(self.actors)
+        self.seqs[actor] += 1
+        start = self.max_op + 1
+        ops = ops_builder(start, actor)
+        if not ops:
+            self.seqs[actor] -= 1
+            return None
+        deps = set(self.heads)
+        if self.last_hash[actor]:
+            deps.add(self.last_hash[actor])
+        buf, h = make_change(actor, self.seqs[actor], start, deps, ops)
+        self.last_hash[actor] = h
+        self.max_op = start + len(ops) - 1
+        return buf
+
+    def next_change(self, heads):
+        self.heads = heads
+        rng = self.rng
+
+        def build(start, actor):
+            ops = []
+            ctr = start
+            for _ in range(rng.randrange(1, 4)):
+                roll = rng.random()
+                if (roll < 0.15 and len(self.lists) < 3) or not self.lists:
+                    key = f"list{len(self.lists)}"
+                    action = "makeList" if rng.random() < 0.7 else "makeText"
+                    ops.append({"action": action, "obj": "_root", "key": key,
+                                "pred": ([self.map_winner[key]]
+                                         if key in self.map_winner else [])})
+                    obj = f"{ctr}@{actor}"
+                    self.lists[obj] = []
+                    self.list_keys[obj] = key
+                    self.map_winner[key] = obj
+                elif roll < 0.55:
+                    obj = rng.choice(sorted(self.lists))
+                    live = self.lists[obj]
+                    ref = "_head" if not live or rng.random() < 0.3 else rng.choice(live)
+                    ops.append({"action": "set", "obj": obj, "elemId": ref,
+                                "insert": True, "datatype": "uint",
+                                "value": rng.randrange(1000), "pred": []})
+                    elem = f"{ctr}@{actor}"
+                    live.append(elem)
+                    self.elem_winner[(obj, elem)] = elem
+                elif roll < 0.75:
+                    obj = rng.choice(sorted(self.lists))
+                    live = self.lists[obj]
+                    if not live:
+                        continue
+                    elem = rng.choice(live)
+                    ops.append({"action": "set", "obj": obj, "elemId": elem,
+                                "datatype": "uint",
+                                "value": rng.randrange(1000),
+                                "pred": [self.elem_winner[(obj, elem)]]})
+                    self.elem_winner[(obj, elem)] = f"{ctr}@{actor}"
+                elif roll < 0.85:
+                    obj = rng.choice(sorted(self.lists))
+                    live = self.lists[obj]
+                    if not live:
+                        continue
+                    elem = rng.choice(live)
+                    ops.append({"action": "del", "obj": obj, "elemId": elem,
+                                "pred": [self.elem_winner[(obj, elem)]]})
+                    live.remove(elem)
+                    self.elem_winner.pop((obj, elem), None)
+                else:
+                    key = f"k{rng.randrange(3)}"
+                    prev = self.map_winner.get(key)
+                    ops.append({"action": "set", "obj": "_root", "key": key,
+                                "datatype": "uint",
+                                "value": rng.randrange(1000),
+                                "pred": [prev] if prev else []})
+                    self.map_winner[key] = f"{ctr}@{actor}"
+                ctr = start + len(ops)
+            return ops
+
+        return self._new_change(build)
+
+
+def run_list_differential(num_docs, num_rounds, seed):
+    farm = TpuDocFarm(num_docs, capacity=512)
+    opsets = [OpSet() for _ in range(num_docs)]
+    loads = [ListWorkload(seed + 31 * d) for d in range(num_docs)]
+    farm_docs = [Frontend.init() for _ in range(num_docs)]
+    seq_docs = [Frontend.init() for _ in range(num_docs)]
+
+    for rnd in range(num_rounds):
+        per_doc = []
+        for d in range(num_docs):
+            buf = loads[d].next_change(opsets[d].heads)
+            per_doc.append([buf] if buf else [])
+        expected = [opsets[d].apply_changes(per_doc[d]) for d in range(num_docs)]
+        got = farm.apply_changes(per_doc)
+        for d in range(num_docs):
+            if not per_doc[d]:
+                continue
+            seq_docs[d] = Frontend.apply_patch(seq_docs[d], expected[d])
+            farm_docs[d] = Frontend.apply_patch(farm_docs[d], got[d])
+            a = materialize(farm_docs[d])
+            b = materialize(seq_docs[d])
+            assert a == b, f"round {rnd} doc {d}:\n  farm {a}\n  seq  {b}"
+            # structural metadata parity
+            assert got[d]["maxOp"] == expected[d]["maxOp"]
+            assert got[d]["deps"] == expected[d]["deps"]
+
+    # whole-document patches materialise identically too
+    for d in range(num_docs):
+        fd = Frontend.apply_patch(Frontend.init(), farm.get_patch(d))
+        sd = Frontend.apply_patch(Frontend.init(), opsets[d].get_patch())
+        assert materialize(fd) == materialize(sd), f"get_patch doc {d}"
+
+
+class TestFarmListsBasics:
+    def test_insert_and_materialize(self):
+        farm = TpuDocFarm(1, capacity=32)
+        opset = OpSet()
+        buf, _ = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []},
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "_head",
+             "insert": True, "datatype": "uint", "value": 7, "pred": []},
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "2@aaaaaaaa",
+             "insert": True, "datatype": "uint", "value": 8, "pred": []},
+        ])
+        expected = opset.apply_changes([buf])
+        (got,) = farm.apply_changes([[buf]])
+        fd = Frontend.apply_patch(Frontend.init(), got)
+        sd = Frontend.apply_patch(Frontend.init(), expected)
+        assert materialize(fd) == materialize(sd) == {"l": [7, 8]}
+
+    def test_delete_element(self):
+        farm = TpuDocFarm(1, capacity=32)
+        opset = OpSet()
+        buf1, h1 = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []},
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "_head",
+             "insert": True, "datatype": "uint", "value": 1, "pred": []},
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "2@aaaaaaaa",
+             "insert": True, "datatype": "uint", "value": 2, "pred": []},
+        ])
+        buf2, _ = make_change("aaaaaaaa", 2, 4, [h1], [
+            {"action": "del", "obj": "1@aaaaaaaa", "elemId": "2@aaaaaaaa",
+             "pred": ["2@aaaaaaaa"]},
+        ])
+        opset.apply_changes([buf1])
+        farm.apply_changes([[buf1]])
+        expected = opset.apply_changes([buf2])
+        (got,) = farm.apply_changes([[buf2]])
+        fd = Frontend.apply_patch(
+            Frontend.apply_patch(Frontend.init(), farm.get_patch(0)), got
+        )
+        assert got["maxOp"] == expected["maxOp"]
+        fd = Frontend.apply_patch(Frontend.init(), farm.get_patch(0))
+        sd = Frontend.apply_patch(Frontend.init(), opset.get_patch())
+        assert materialize(fd) == materialize(sd) == {"l": [2]}
+
+    def test_concurrent_head_inserts_order(self):
+        """Two concurrent head inserts: higher opId wins position 0 (RGA)."""
+        farm = TpuDocFarm(1, capacity=32)
+        opset = OpSet()
+        buf0, h0 = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []}])
+        buf_a, _ = make_change("aaaaaaaa", 2, 2, [h0], [
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "_head",
+             "insert": True, "datatype": "uint", "value": 10, "pred": []}])
+        buf_b, _ = make_change("bbbbbbbb", 1, 2, [h0], [
+            {"action": "set", "obj": "1@aaaaaaaa", "elemId": "_head",
+             "insert": True, "datatype": "uint", "value": 20, "pred": []}])
+        expected1 = opset.apply_changes([buf0, buf_a, buf_b])
+        (got1,) = farm.apply_changes([[buf0, buf_a, buf_b]])
+        fd = Frontend.apply_patch(Frontend.init(), got1)
+        sd = Frontend.apply_patch(Frontend.init(), expected1)
+        assert materialize(fd) == materialize(sd)
+
+    def test_nested_map_inside_list(self):
+        farm = TpuDocFarm(1, capacity=32)
+        opset = OpSet()
+        buf, _ = make_change("aaaaaaaa", 1, 1, [], [
+            {"action": "makeList", "obj": "_root", "key": "l", "pred": []},
+            {"action": "makeMap", "obj": "1@aaaaaaaa", "elemId": "_head",
+             "insert": True, "pred": []},
+            {"action": "set", "obj": "2@aaaaaaaa", "key": "x",
+             "datatype": "uint", "value": 5, "pred": []},
+        ])
+        expected = opset.apply_changes([buf])
+        (got,) = farm.apply_changes([[buf]])
+        fd = Frontend.apply_patch(Frontend.init(), got)
+        sd = Frontend.apply_patch(Frontend.init(), expected)
+        assert materialize(fd) == materialize(sd) == {"l": [{"x": 5}]}
+
+
+class TestFarmListsDifferential:
+    def test_single_doc(self):
+        run_list_differential(1, 12, seed=11)
+
+    def test_multi_doc(self):
+        run_list_differential(3, 10, seed=12)
+
+    def test_longer_churn(self):
+        run_list_differential(2, 18, seed=13)
