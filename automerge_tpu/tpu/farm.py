@@ -24,13 +24,20 @@ with per-target accumulated totals (new.js:937-965), deleted keys as empty
 conflict maps.
 
 Map-family keys (maps, tables, counters, nested trees) get reference-exact
-patch parity. List/text objects run through the same device kernels — one
-element table per doc feeds the batched RGA rank kernel (rga.py) for
-document order, and per-element conflict resolution rides the map engine —
-with patches emitted as a sequential diff script (insert/update/remove with
-the reference's multi-insert compaction) between the previously-emitted and
-current visible sequences: state-exact (the frontend materialises the same
-document), though not byte-exact to the sequential walk's edit stream.
+patch parity via the batched device path. List/text objects additionally
+run through the reference merge walk (the sequential engine in opset.py,
+embedded lazily per document): the reference's incremental list edit
+stream is an order-dependent state machine (listIndex increments only
+after updatePatchProperty at insert boundaries, propState action
+conversions, appendUpdate conflict popping — new.js:747-1033) whose output
+is NOT a function of (old state, new state) alone, so no state diff can
+reproduce it byte-for-byte. Documents that have never seen a list op pay
+nothing for this; the first list op replays that doc's committed changes
+through the walk once, and from then on its incremental patches are
+byte-exact by construction. The device engine still carries every doc's
+rows (including list rows: element forests feed the batched RGA rank
+kernel in rga.py) for whole-document visibility, conflict winners,
+counter totals, and the sync kernels at batch scale.
 """
 from __future__ import annotations
 
@@ -40,6 +47,7 @@ import numpy as np
 
 from ..columnar import decode_change, decode_change_meta
 from ..common import utf16_key
+from ..opset import OpSet
 from .engine import (
     ACTION_DEL,
     ACTION_INC,
@@ -127,9 +135,10 @@ class TpuDocFarm:
         self.elem_index = [{} for _ in range(num_docs)]  # elemId -> local idx
         self.elem_ids = [[] for _ in range(num_docs)]  # local idx -> elemId
         self.elem_object = [[] for _ in range(num_docs)]  # local idx -> objectId
-        # last emitted visible sequence per list object, for the diff-script
-        # patch emission: objectId -> [(elemId, winner_packed, total)]
-        self.list_cache = [{} for _ in range(num_docs)]
+        # reference merge walk per doc, created lazily on the first op that
+        # targets a list/text object (see module docstring): authoritative
+        # for that doc's incremental patch stream from then on
+        self.exact: list[OpSet | None] = [None] * num_docs
 
     # ------------------------------------------------------------------ #
     # transcoding
@@ -357,12 +366,6 @@ class TpuDocFarm:
                 close(run)
                 run = None
                 last_batch = gate_batch
-            if "key" not in op or op.get("insert") or op.get("elemId") is not None:
-                # list/text op: breaks the map run; list patches are emitted
-                # by the diff-script path, not the cutoff machinery
-                close(run)
-                run = None
-                continue
             key = op["key"]
             obj = op["obj"]
             lam = (ctr, actor)
@@ -434,6 +437,55 @@ class TpuDocFarm:
         return applied, enqueued
 
     # ------------------------------------------------------------------ #
+    # the reference merge walk (lazily embedded per doc)
+
+    def _ensure_exact(self, d: int) -> OpSet:
+        """Bootstraps the reference walk for doc `d` by replaying its
+        committed change log (and re-delivering its queued changes), so the
+        walk's state matches the farm's exactly from this call onward."""
+        if self.exact[d] is None:
+            opset = OpSet()
+            if self.changes[d]:
+                opset.apply_changes(list(self.changes[d]))
+            for change in self.queue[d]:
+                opset.apply_changes([change["buffer"]])
+            self.exact[d] = opset
+        return self.exact[d]
+
+    @staticmethod
+    def _targets_list(decoded_changes) -> bool:
+        return any(
+            op.get("insert") or op.get("elemId") is not None
+            for change in decoded_changes
+            for op in change["ops"]
+        )
+
+    def _prevalidate_limits(self, d: int, decoded_changes) -> None:
+        """Raises the farm's packing-limit errors BEFORE the embedded walk
+        commits anything, so a failed apply leaves walk and device state
+        consistent (the walk has no such limits and would otherwise commit
+        changes the device path then rejects)."""
+        from . import rga
+
+        inserts = 0
+        for change in decoded_changes:
+            ctr = change["startOp"]
+            for op in change["ops"]:
+                if op.get("insert"):
+                    inserts += 1
+                    if ctr >= rga.MAX_COUNTER:
+                        raise ValueError(
+                            f"op counter {ctr} exceeds the rank kernel's "
+                            "packing range"
+                        )
+                ctr += 1
+        if int(self.num_elems[d]) + inserts > rga.MAX_ELEMS:
+            raise ValueError(
+                f"document exceeds {rga.MAX_ELEMS} list elements (incl. "
+                "tombstones): beyond the rank kernel's key-packing range"
+            )
+
+    # ------------------------------------------------------------------ #
     # the batched applyChanges step
 
     def apply_changes(self, per_doc_buffers, is_local=False):
@@ -445,6 +497,7 @@ class TpuDocFarm:
         applied_ops = [[] for _ in range(self.num_docs)]
         touched_objects = [set() for _ in range(self.num_docs)]
         applied_changes = [[] for _ in range(self.num_docs)]
+        exact_patches: dict[int, dict] = {}
 
         for d, buffers in enumerate(per_doc_buffers):
             decoded = []
@@ -452,6 +505,19 @@ class TpuDocFarm:
                 change = decode_change(buffer)
                 change["buffer"] = bytes(buffer)
                 decoded.append(change)
+            # list/text-targeting docs route through the reference walk,
+            # whose patch is authoritative for them (byte-exact edit
+            # streams; see module docstring). Run it BEFORE the farm's own
+            # gate so error behaviour (seq reuse, missing objects) matches
+            # the sequential engine's.
+            if decoded and (
+                self.exact[d] is not None or self._targets_list(decoded)
+            ):
+                self._prevalidate_limits(d, decoded)
+                self._ensure_exact(d)
+                exact_patches[d] = self.exact[d].apply_changes(
+                    [c["buffer"] for c in decoded], is_local
+                )
             pending = decoded + self.queue[d] if self.queue[d] else decoded
             gate_batch = 0
             while True:
@@ -509,16 +575,21 @@ class TpuDocFarm:
             )
 
         # no-op deliveries (all queued or duplicates) need no device work
-        vis = self._read_visibility() if width > 0 else None
-        ranks = None
-        if vis is not None and int(self.num_elems.max(initial=0)) > 0:
-            ranks = self._element_ranks()
+        need_device_patch = [
+            d for d in range(self.num_docs) if d not in exact_patches
+        ]
+        vis = (
+            self._read_visibility()
+            if width > 0 and need_device_patch
+            else None
+        )
         patches = []
         for d in range(self.num_docs):
+            if d in exact_patches:
+                patches.append(exact_patches[d])
+                continue
             cutoffs = self._compute_cutoffs(d, applied_ops[d])
-            diffs = self._build_diffs(
-                d, vis, cutoffs, touched_objects[d], ranks
-            )
+            diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
             patch = {
                 "maxOp": self.max_op[d],
                 "clock": self.clock[d],
@@ -701,55 +772,11 @@ class TpuDocFarm:
                 seq.append((elem_id, best[0], best[1]))
         return seq
 
-    def _diff_edits(self, d, patches, edits, old_seq, new_seq, edited):
-        """Sequential edit script turning the previously-emitted visible
-        sequence into the current one. RGA never reorders surviving
-        elements, so old and new are subsequences of one document order and
-        a two-pointer identity walk suffices; append_edit applies the
-        reference's multi-insert/remove-count compaction (new.js:747)."""
-        from ..opset import append_edit
-
-        old_ids = {e for e, _, _ in old_seq}
-        new_ids = {e for e, _, _ in new_seq}
-        i = j = index = 0
-        while i < len(old_seq) or j < len(new_seq):
-            if i < len(old_seq) and old_seq[i][0] not in new_ids:
-                append_edit(edits, {"action": "remove", "index": index, "count": 1})
-                edited.add(old_seq[i][0])
-                i += 1
-            elif j < len(new_seq) and new_seq[j][0] not in old_ids:
-                elem_id, packed, total = new_seq[j]
-                append_edit(edits, {
-                    "action": "insert", "index": index, "elemId": elem_id,
-                    "opId": self._opid_str(packed),
-                    "value": self._value_diff(d, patches, packed, total),
-                })
-                edited.add(elem_id)
-                j += 1
-                index += 1
-            else:
-                e_old, w_old, t_old = old_seq[i]
-                e_new, w_new, t_new = new_seq[j]
-                if e_old != e_new:  # defensive: treat as remove (cannot occur
-                    append_edit(edits, {"action": "remove", "index": index,
-                                        "count": 1})  # if RGA order holds
-                    edited.add(e_old)
-                    i += 1
-                    continue
-                if (w_old, t_old) != (w_new, t_new):
-                    append_edit(edits, {
-                        "action": "update", "index": index,
-                        "opId": self._opid_str(w_new),
-                        "value": self._value_diff(d, patches, w_new, t_new),
-                    })
-                    edited.add(e_new)
-                i += 1
-                j += 1
-                index += 1
-
-    def _build_diffs(self, d, vis, cutoffs, touched_objects, ranks=None):
+    def _build_diffs(self, d, vis, cutoffs, touched_objects):
+        """Patch assembly for map-family docs from device visibility. Docs
+        that touch list/text objects never reach this path (they are served
+        by the embedded reference walk; see apply_changes)."""
         patches = {"_root": _empty_object_patch("_root", "map")}
-        edited_elems = set()  # elemIds already covered by an edit this call
 
         for slot in sorted(cutoffs):
             obj, key = self.slots.lookup(slot)
@@ -766,20 +793,6 @@ class TpuDocFarm:
                 )
             self._update_children_cache(d, slot, cutoffs[slot], rows)
 
-        # list/text objects: diff-script edits against the last emitted
-        # visible sequence (the RGA structural path; order from the device
-        # rank kernel)
-        for obj in sorted(touched_objects):
-            meta = self.object_meta[d].get(obj)
-            if meta is None or meta["type"] not in ("list", "text"):
-                continue
-            patch = self._ensure_patch(d, patches, obj)
-            new_seq = self._visible_sequence(d, vis, ranks, obj)
-            old_seq = self.list_cache[d].get(obj, [])
-            self._diff_edits(d, patches, patch["edits"], old_seq, new_seq,
-                             edited_elems)
-            self.list_cache[d][obj] = new_seq
-
         # link touched objects up to the root (setupPatches, new.js:1461)
         for object_id in sorted(touched_objects):
             meta = self.object_meta[d].get(object_id)
@@ -788,63 +801,28 @@ class TpuDocFarm:
             child_meta = None
             patch_exists = False
             while True:
-                parent_is_list = (
-                    child_meta is not None
-                    and meta["type"] in ("list", "text")
-                )
                 values = None
-                seq_entry = None
-                if child_meta is not None and not parent_is_list:
+                if child_meta is not None:
                     slot = self.slots.intern((object_id, child_meta["parentKey"]))
                     values = self.children[d].get(slot) or {}
-                elif parent_is_list:
-                    # the connecting key is a list element: visible iff it
-                    # survives in the current sequence
-                    seq = self.list_cache[d].get(object_id)
-                    if seq is None:
-                        seq = self._visible_sequence(d, vis, ranks, object_id)
-                        self.list_cache[d][object_id] = seq
-                    for pos, (elem_id, packed, total) in enumerate(seq):
-                        if elem_id == child_meta["parentKey"]:
-                            seq_entry = (pos, packed, total)
-                            break
-                has_children = (
-                    child_meta is not None
-                    and (seq_entry is not None if parent_is_list else len(values) > 0)
-                )
+                has_children = child_meta is not None and len(values) > 0
                 self._ensure_patch(d, patches, object_id)
                 if child_meta is not None and has_children:
-                    if parent_is_list:
-                        if child_meta["parentKey"] in edited_elems:
+                    props = patches[object_id]["props"].setdefault(
+                        child_meta["parentKey"], {}
+                    )
+                    for op_id, spec in values.items():
+                        if op_id in props:
                             patch_exists = True
+                        elif isinstance(spec, tuple):  # ("child", id)
+                            child = spec[1]
+                            if child not in patches:
+                                patches[child] = _empty_object_patch(
+                                    child, self.object_meta[d][child]["type"]
+                                )
+                            props[op_id] = patches[child]
                         else:
-                            from ..opset import append_edit
-
-                            pos, packed, total = seq_entry
-                            append_edit(patches[object_id]["edits"], {
-                                "action": "update", "index": pos,
-                                "opId": self._opid_str(packed),
-                                "value": self._value_diff(
-                                    d, patches, packed, total
-                                ),
-                            })
-                            edited_elems.add(child_meta["parentKey"])
-                    else:
-                        props = patches[object_id]["props"].setdefault(
-                            child_meta["parentKey"], {}
-                        )
-                        for op_id, spec in values.items():
-                            if op_id in props:
-                                patch_exists = True
-                            elif isinstance(spec, tuple):  # ("child", id)
-                                child = spec[1]
-                                if child not in patches:
-                                    patches[child] = _empty_object_patch(
-                                        child, self.object_meta[d][child]["type"]
-                                    )
-                                props[op_id] = patches[child]
-                            else:
-                                props[op_id] = spec
+                            props[op_id] = spec
                 if (
                     patch_exists
                     or not meta["parentObj"]

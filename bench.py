@@ -3,18 +3,29 @@ sequential reference-parity Python engine.
 
 Workload modelled on BASELINE.json config 1 scaled to a document batch:
 key-set ops applied with applyChanges semantics (sorted merge, succ
-rewriting, visibility). Prints one JSON line:
+rewriting, visibility). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N}
+
+Robustness: the device benchmark runs in a child process so a failed or
+wedged TPU backend initialisation cannot poison this process. The parent
+retries a bounded number of times, then falls back to a CPU run (flagged
+with "backend": "cpu" in the JSON) rather than emitting nothing.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+
+CHILD_TIMEOUT = int(os.environ.get("BENCH_CHILD_TIMEOUT", "420"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+CHILD_RETRIES = int(os.environ.get("BENCH_RETRIES", "2"))
 
 
-def bench_tpu(num_docs, capacity, rounds, ops_per_round, seed=0):
+def bench_device(num_docs, capacity, rounds, ops_per_round, seed=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -55,7 +66,7 @@ def bench_tpu(num_docs, capacity, rounds, ops_per_round, seed=0):
     batches = [jax.device_put(b) for b in batches]
     jax.block_until_ready(batches)
 
-    # warm-up / compile
+    # warm-up / compile (one small batch is enough to build both programs)
     warm = batched_apply_ops(make_empty_state(num_docs, capacity), batches[0])
     warm_v = batched_visible_state(warm)
     jax.block_until_ready((warm, warm_v))
@@ -69,7 +80,11 @@ def bench_tpu(num_docs, capacity, rounds, ops_per_round, seed=0):
     elapsed = time.perf_counter() - start
 
     total_ops = num_docs * rounds * ops_per_round
-    return total_ops / elapsed, elapsed
+    return {
+        "ops_per_sec": total_ops / elapsed,
+        "elapsed_s": elapsed,
+        "backend": jax.default_backend(),
+    }
 
 
 def bench_python(num_docs, rounds, ops_per_round, seed=0):
@@ -110,24 +125,117 @@ def bench_python(num_docs, rounds, ops_per_round, seed=0):
     return total_ops / elapsed, elapsed
 
 
-def main():
+def _child_main():
+    """Runs the device benchmark and prints its result dict as JSON."""
     num_docs = int(os.environ.get("BENCH_DOCS", "8192"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "8"))
     ops_per_round = int(os.environ.get("BENCH_OPS", "64"))
     capacity = rounds * ops_per_round
+    result = bench_device(num_docs, capacity, rounds, ops_per_round)
+    print("BENCH_RESULT " + json.dumps(result))
 
-    tpu_ops_per_sec, tpu_time = bench_tpu(num_docs, capacity, rounds, ops_per_round)
 
+def _run_child(env):
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=CHILD_TIMEOUT,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "bench child rc=%d stderr tail:\n%s" % (proc.returncode, proc.stderr[-2000:])
+        )
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_RESULT "):
+            return json.loads(line[len("BENCH_RESULT "):])
+    raise RuntimeError("bench child produced no result line; stdout tail:\n%s"
+                       % proc.stdout[-2000:])
+
+
+def _probe_device(env):
+    """Fast check that the accelerator backend can initialise at all, so a
+    wedged chip costs PROBE_TIMEOUT rather than the full bench timeout."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); "
+         "assert jax.default_backend() != 'cpu', 'no accelerator backend'; "
+         "import jax.numpy as jnp; jnp.zeros(8).block_until_ready(); "
+         "print('PROBE_OK', jax.default_backend(), len(d))"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=PROBE_TIMEOUT,
+    )
+    if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
+        raise RuntimeError("device probe failed: %s" % proc.stderr[-800:])
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in list(env):
+        if k.startswith("PALLAS_AXON") or k.startswith("AXON_"):
+            env.pop(k)
+    # The host CPU cannot chew the full accelerator workload inside the
+    # child timeout; shrink the batch (throughput is still per-op).
+    env["BENCH_DOCS"] = str(min(int(env.get("BENCH_DOCS", "8192")), 1024))
+    return env
+
+
+def main():
+    errors = []
+    result = None
+    # Try the real accelerator first, with bounded retries (the tunnelled
+    # chip can be cold or transiently unavailable).
+    for attempt in range(CHILD_RETRIES + 1):
+        try:
+            _probe_device(dict(os.environ))
+            result = _run_child(dict(os.environ))
+            break
+        except subprocess.TimeoutExpired as e:
+            errors.append(f"device attempt {attempt + 1}: timeout ({e.timeout}s)")
+            if attempt < CHILD_RETRIES:
+                time.sleep(5 * (attempt + 1))
+        except Exception as e:  # noqa: BLE001 - deliberately broad: any child failure
+            errors.append(f"device attempt {attempt + 1}: {e}")
+            if attempt < CHILD_RETRIES:
+                time.sleep(5 * (attempt + 1))
+    if result is None:
+        # CPU fallback: a measured number on the host beats no number.
+        try:
+            result = _run_child(_cpu_env())
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"cpu fallback: {e}")
+    if result is None:
+        print(json.dumps({
+            "metric": "batched merge throughput (applyChanges ops/sec/chip)",
+            "value": 0,
+            "unit": "ops/sec",
+            "vs_baseline": 0,
+            "error": "; ".join(errors)[-1500:],
+        }))
+        return
+
+    num_docs = int(os.environ.get("BENCH_DOCS", "8192"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "8"))
+    ops_per_round = int(os.environ.get("BENCH_OPS", "64"))
     baseline_docs = max(2, min(8, num_docs))
     py_ops_per_sec, _ = bench_python(baseline_docs, rounds, ops_per_round)
 
-    print(json.dumps({
+    out = {
         "metric": "batched merge throughput (applyChanges ops/sec/chip)",
-        "value": round(tpu_ops_per_sec),
+        "value": round(result["ops_per_sec"]),
         "unit": "ops/sec",
-        "vs_baseline": round(tpu_ops_per_sec / py_ops_per_sec, 2),
-    }))
+        "vs_baseline": round(result["ops_per_sec"] / py_ops_per_sec, 2),
+        "backend": result["backend"],
+    }
+    if errors:
+        out["retried"] = len(errors)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child_main()
+    else:
+        main()

@@ -1,10 +1,10 @@
-"""List/Text support in TpuDocFarm: state-exact differential suite.
+"""List/Text support in TpuDocFarm: byte-exact differential suite.
 
-The farm's list patches are a sequential diff script (not the reference's
-byte-exact edit stream), so the oracle here is the materialised document:
-both backends' patches drive real frontend documents, which must stay
-identical tree-for-tree every round (the cross-backend doc-equality half of
-the reference's test/wasm.js)."""
+List-touching docs route through the farm's embedded reference walk, so
+their incremental patches must equal the sequential engine's exactly (dict
+equality — the reference's order-dependent edit-stream quirks included).
+Materialised-document equality is additionally asserted both ways (the
+cross-backend doc-equality half of the reference's test/wasm.js)."""
 import random
 
 import pytest
@@ -158,19 +158,24 @@ def run_list_differential(num_docs, num_rounds, seed):
         for d in range(num_docs):
             if not per_doc[d]:
                 continue
+            # byte-exact patch parity: the whole patch dict must match,
+            # including the order-dependent list edit stream
+            assert got[d] == expected[d], (
+                f"round {rnd} doc {d}:\n  farm {got[d]}\n  seq  {expected[d]}"
+            )
             seq_docs[d] = Frontend.apply_patch(seq_docs[d], expected[d])
             farm_docs[d] = Frontend.apply_patch(farm_docs[d], got[d])
             a = materialize(farm_docs[d])
             b = materialize(seq_docs[d])
             assert a == b, f"round {rnd} doc {d}:\n  farm {a}\n  seq  {b}"
-            # structural metadata parity
-            assert got[d]["maxOp"] == expected[d]["maxOp"]
-            assert got[d]["deps"] == expected[d]["deps"]
 
-    # whole-document patches materialise identically too
+    # whole-document patches are dict-exact as well: the device path (RGA
+    # rank kernel + device visibility) must reproduce the sequential scan
     for d in range(num_docs):
-        fd = Frontend.apply_patch(Frontend.init(), farm.get_patch(d))
-        sd = Frontend.apply_patch(Frontend.init(), opsets[d].get_patch())
+        fp, sp = farm.get_patch(d), opsets[d].get_patch()
+        assert fp == sp, f"get_patch doc {d}:\n  farm {fp}\n  seq  {sp}"
+        fd = Frontend.apply_patch(Frontend.init(), fp)
+        sd = Frontend.apply_patch(Frontend.init(), sp)
         assert materialize(fd) == materialize(sd), f"get_patch doc {d}"
 
 
