@@ -47,6 +47,10 @@ def _load():
     lib.am_bool_decode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
     lib.am_bool_encode.restype = ctypes.c_int64
     lib.am_bool_encode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+    if hasattr(lib, "am_strrle_decode"):
+        lib.am_strrle_decode.restype = ctypes.c_int64
+        lib.am_strrle_decode.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                         ctypes.c_size_t, i64p, ctypes.c_size_t]
     _lib = lib
     return lib
 
@@ -135,6 +139,25 @@ def bool_decode(buf: bytes, max_count: int = None) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap,
     )
     return out[:_check(rc, "bool_decode")].astype(bool)
+
+
+def strrle_decode(buf: bytes, max_count: int = None):
+    """Decodes a string-RLE column; returns (blob bytes, offsets int64[n,2])
+    where a row's string is blob[start:end], or (-1, -1) for null."""
+    lib = _load()
+    if not hasattr(lib, "am_strrle_decode"):
+        raise AttributeError("native library predates am_strrle_decode; rebuild")
+    cap = max_count if max_count is not None else max(16, len(buf) * 64)
+    blob_cap = max(64, len(buf) * 64)
+    blob = np.empty(blob_cap, np.uint8)
+    offs = np.empty(cap * 2, np.int64)
+    rc = lib.am_strrle_decode(
+        _as_u8p(buf), len(buf),
+        blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), blob_cap,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap,
+    )
+    n = _check(rc, "strrle_decode")
+    return blob.tobytes(), offs[: 2 * n].reshape(n, 2)
 
 
 def bool_encode(values: np.ndarray) -> bytes:

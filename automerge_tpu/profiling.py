@@ -1,0 +1,95 @@
+"""Per-phase timing for the batched backend (SURVEY.md §5.1).
+
+The reference has no profiling layer (only nyc coverage); for the TPU
+build a phase breakdown is a first-class requirement: the applyChanges
+pipeline spans host decode, the causal gate, dense-row transcoding, the
+device merge program, and host patch assembly, and optimisation work needs
+to know where the time goes (the bench's phase table is built on this).
+
+Usage:
+    prof = PhaseProfile()
+    with prof.phase("decode"):
+        ...
+    prof.as_dict()   # {"decode": {"total_s": ..., "calls": ...}, ...}
+
+Timers nest (a phase started inside another phase simply accumulates to
+its own bucket); `enabled=False` turns every context into a no-op with a
+single attribute test of overhead. A module-level `get_profile()` hands
+out the ambient profile installed by `use_profile()` so deep call sites
+(the farm, the engine) need no plumbing.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+
+class PhaseProfile:
+    """Accumulates wall-clock totals and call counts per named phase."""
+
+    __slots__ = ("totals", "counts", "enabled")
+
+    def __init__(self, enabled: bool = True):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self.enabled = enabled
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + elapsed
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+    def as_dict(self) -> dict:
+        return {
+            name: {"total_s": self.totals[name], "calls": self.counts[name]}
+            for name in sorted(self.totals)
+        }
+
+    def table(self) -> str:
+        """Human-readable breakdown, largest phase first."""
+        if not self.totals:
+            return "(no phases recorded)"
+        width = max(len(n) for n in self.totals)
+        total = sum(self.totals.values())
+        lines = []
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            t = self.totals[name]
+            lines.append(
+                f"{name.ljust(width)}  {t * 1e3:10.2f} ms  "
+                f"{100 * t / total:5.1f}%  x{self.counts[name]}"
+            )
+        return "\n".join(lines)
+
+
+_NULL = PhaseProfile(enabled=False)
+_current = _NULL
+
+
+def get_profile() -> PhaseProfile:
+    """The ambient profile (a disabled no-op unless one is installed)."""
+    return _current
+
+
+@contextlib.contextmanager
+def use_profile(profile: PhaseProfile) -> Iterator[PhaseProfile]:
+    """Installs `profile` as the ambient profile for the dynamic extent."""
+    global _current
+    prev = _current
+    _current = profile
+    try:
+        yield profile
+    finally:
+        _current = prev

@@ -491,7 +491,14 @@ class TpuDocFarm:
     def apply_changes(self, per_doc_buffers, is_local=False):
         """Applies binary changes to every document (one device merge for
         the whole batch) and returns one reference-format patch per doc.
-        `per_doc_buffers` is a list of num_docs lists of change buffers."""
+        `per_doc_buffers` is a list of num_docs lists of change buffers.
+
+        Phases (recorded on the ambient PhaseProfile, SURVEY §5.1):
+        decode -> walk (exact docs) -> gate+transcode -> pack ->
+        device_dispatch -> visibility -> patch_assembly."""
+        from ..profiling import get_profile
+
+        prof = get_profile()
         assert len(per_doc_buffers) == self.num_docs
         per_doc_rows = [[] for _ in range(self.num_docs)]
         applied_ops = [[] for _ in range(self.num_docs)]
@@ -499,108 +506,131 @@ class TpuDocFarm:
         applied_changes = [[] for _ in range(self.num_docs)]
         exact_patches: dict[int, dict] = {}
 
-        for d, buffers in enumerate(per_doc_buffers):
-            decoded = []
-            for buffer in buffers:
-                change = decode_change(buffer)
-                change["buffer"] = bytes(buffer)
-                decoded.append(change)
-            # list/text-targeting docs route through the reference walk,
-            # whose patch is authoritative for them (byte-exact edit
-            # streams; see module docstring). Run it BEFORE the farm's own
-            # gate so error behaviour (seq reuse, missing objects) matches
-            # the sequential engine's.
-            if decoded and (
-                self.exact[d] is not None or self._targets_list(decoded)
-            ):
-                self._prevalidate_limits(d, decoded)
-                self._ensure_exact(d)
-                exact_patches[d] = self.exact[d].apply_changes(
-                    [c["buffer"] for c in decoded], is_local
-                )
-            pending = decoded + self.queue[d] if self.queue[d] else decoded
-            gate_batch = 0
-            while True:
-                applied, pending = self._gate_round(d, pending)
-                if not applied:
-                    break
-                gate_batch += 1
-                for change in applied:
-                    ctr = change["startOp"]
-                    for op in change["ops"]:
-                        rows = self._op_rows(d, op, ctr, change["actor"])
-                        per_doc_rows[d].extend(rows)
-                        applied_ops[d].append((op, ctr, change["actor"], gate_batch))
-                        touched_objects[d].add(op["obj"])
-                        ctr += 1
-                    self.max_op[d] = max(self.max_op[d], ctr - 1)
-                    applied_changes[d].append(change)
-                    # commit immediately so later gate rounds (and later
-                    # calls) see this hash as a satisfied dependency
-                    self.changes[d].append(change["buffer"])
-                    self.change_index_by_hash[d][change["hash"]] = (
-                        len(self.changes[d]) - 1
+        with prof.phase("decode"):
+            per_doc_decoded = []
+            for buffers in per_doc_buffers:
+                decoded = []
+                for buffer in buffers:
+                    change = decode_change(buffer)
+                    change["buffer"] = bytes(buffer)
+                    decoded.append(change)
+                per_doc_decoded.append(decoded)
+
+        # list/text-targeting docs route through the reference walk, whose
+        # patch is authoritative for them (byte-exact edit streams; see
+        # module docstring). Run it BEFORE the farm's own gate so error
+        # behaviour (seq reuse, missing objects) matches the sequential
+        # engine's.
+        with prof.phase("walk"):
+            for d, decoded in enumerate(per_doc_decoded):
+                if decoded and (
+                    self.exact[d] is not None or self._targets_list(decoded)
+                ):
+                    self._prevalidate_limits(d, decoded)
+                    self._ensure_exact(d)
+                    exact_patches[d] = self.exact[d].apply_changes(
+                        [c["buffer"] for c in decoded], is_local
                     )
-                    by_actor = self.hashes_by_actor[d].setdefault(change["actor"], [])
-                    while len(by_actor) < change["seq"]:
-                        by_actor.append(None)
-                    by_actor[change["seq"] - 1] = change["hash"]
-                    self.dependencies_by_hash[d][change["hash"]] = list(change["deps"])
-                    self.dependents_by_hash[d].setdefault(change["hash"], [])
-                    for dep in change["deps"]:
-                        self.dependents_by_hash[d].setdefault(dep, []).append(
-                            change["hash"]
+
+        with prof.phase("gate+transcode"):
+            for d, decoded in enumerate(per_doc_decoded):
+                pending = decoded + self.queue[d] if self.queue[d] else decoded
+                gate_batch = 0
+                while True:
+                    applied, pending = self._gate_round(d, pending)
+                    if not applied:
+                        break
+                    gate_batch += 1
+                    for change in applied:
+                        ctr = change["startOp"]
+                        for op in change["ops"]:
+                            rows = self._op_rows(d, op, ctr, change["actor"])
+                            per_doc_rows[d].extend(rows)
+                            applied_ops[d].append(
+                                (op, ctr, change["actor"], gate_batch)
+                            )
+                            touched_objects[d].add(op["obj"])
+                            ctr += 1
+                        self.max_op[d] = max(self.max_op[d], ctr - 1)
+                        applied_changes[d].append(change)
+                        # commit immediately so later gate rounds (and later
+                        # calls) see this hash as a satisfied dependency
+                        self.changes[d].append(change["buffer"])
+                        self.change_index_by_hash[d][change["hash"]] = (
+                            len(self.changes[d]) - 1
                         )
-                if not pending:
-                    break
-            self.queue[d] = pending
+                        by_actor = self.hashes_by_actor[d].setdefault(
+                            change["actor"], []
+                        )
+                        while len(by_actor) < change["seq"]:
+                            by_actor.append(None)
+                        by_actor[change["seq"] - 1] = change["hash"]
+                        self.dependencies_by_hash[d][change["hash"]] = list(
+                            change["deps"]
+                        )
+                        self.dependents_by_hash[d].setdefault(change["hash"], [])
+                        for dep in change["deps"]:
+                            self.dependents_by_hash[d].setdefault(dep, []).append(
+                                change["hash"]
+                            )
+                    if not pending:
+                        break
+                self.queue[d] = pending
 
         # one device merge for the whole batch
         width = max((len(r) for r in per_doc_rows), default=0)
         if width > 0:
-            keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
-            ops = np.zeros((self.num_docs, width), np.int64)
-            actions = np.zeros((self.num_docs, width), np.int32)
-            values = np.zeros((self.num_docs, width), np.int64)
-            preds = np.full((self.num_docs, width), -1, np.int64)
-            for d, rows in enumerate(per_doc_rows):
-                for i, (slot, packed, action, value, pred) in enumerate(rows):
-                    keys[d, i] = slot
-                    ops[d, i] = packed
-                    actions[d, i] = action
-                    values[d, i] = value
-                    preds[d, i] = pred
-            self.engine.apply_batch(
-                changes_from_numpy(keys, ops, actions, values, preds)
-            )
+            with prof.phase("pack"):
+                keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
+                ops = np.zeros((self.num_docs, width), np.int64)
+                actions = np.zeros((self.num_docs, width), np.int32)
+                values = np.zeros((self.num_docs, width), np.int64)
+                preds = np.full((self.num_docs, width), -1, np.int64)
+                for d, rows in enumerate(per_doc_rows):
+                    for i, (slot, packed, action, value, pred) in enumerate(rows):
+                        keys[d, i] = slot
+                        ops[d, i] = packed
+                        actions[d, i] = action
+                        values[d, i] = value
+                        preds[d, i] = pred
+            with prof.phase("device_dispatch"):
+                self.engine.apply_batch(
+                    changes_from_numpy(keys, ops, actions, values, preds)
+                )
 
         # no-op deliveries (all queued or duplicates) need no device work
         need_device_patch = [
             d for d in range(self.num_docs) if d not in exact_patches
         ]
-        vis = (
-            self._read_visibility()
-            if width > 0 and need_device_patch
-            else None
-        )
-        patches = []
-        for d in range(self.num_docs):
-            if d in exact_patches:
-                patches.append(exact_patches[d])
-                continue
-            cutoffs = self._compute_cutoffs(d, applied_ops[d])
-            diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
-            patch = {
-                "maxOp": self.max_op[d],
-                "clock": self.clock[d],
-                "deps": self.heads[d],
-                "pendingChanges": len(self.queue[d]),
-                "diffs": diffs,
-            }
-            if is_local and len(per_doc_buffers[d]) == 1 and applied_changes[d]:
-                patch["actor"] = applied_changes[d][0]["actor"]
-                patch["seq"] = applied_changes[d][0]["seq"]
-            patches.append(patch)
+        with prof.phase("visibility"):
+            vis = (
+                self._read_visibility()
+                if width > 0 and need_device_patch
+                else None
+            )
+        with prof.phase("patch_assembly"):
+            patches = []
+            for d in range(self.num_docs):
+                if d in exact_patches:
+                    patches.append(exact_patches[d])
+                    continue
+                cutoffs = self._compute_cutoffs(d, applied_ops[d])
+                diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
+                patch = {
+                    "maxOp": self.max_op[d],
+                    "clock": self.clock[d],
+                    "deps": self.heads[d],
+                    "pendingChanges": len(self.queue[d]),
+                    "diffs": diffs,
+                }
+                if (
+                    is_local
+                    and len(per_doc_buffers[d]) == 1
+                    and applied_changes[d]
+                ):
+                    patch["actor"] = applied_changes[d][0]["actor"]
+                    patch["seq"] = applied_changes[d][0]["seq"]
+                patches.append(patch)
         return patches
 
     # ------------------------------------------------------------------ #

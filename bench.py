@@ -87,6 +87,69 @@ def bench_device(num_docs, capacity, rounds, ops_per_round, seed=0):
     }
 
 
+def _make_change_stream(rounds, ops_per_round, seed=0):
+    """One actor's binary change stream for the end-to-end workload (the
+    same key-set shape as the device bench, encoded through the real wire
+    format)."""
+    import random
+
+    from automerge_tpu.columnar import decode_change_columns, encode_change
+
+    rng = random.Random(seed)
+    actor = "aaaaaaaa"
+    buffers, last, max_op, deps = [], {}, 0, []
+    for r in range(rounds):
+        ops = []
+        start_op = max_op + 1
+        ctr = start_op
+        for _ in range(ops_per_round):
+            key = f"k{rng.randrange(64)}"
+            ops.append({"action": "set", "obj": "_root", "key": key,
+                        "datatype": "uint", "value": rng.randrange(10**6),
+                        "pred": [last[key]] if key in last else []})
+            last[key] = f"{ctr}@{actor}"
+            ctr += 1
+        max_op = ctr - 1
+        buf = encode_change({"actor": actor, "seq": r + 1, "startOp": start_op,
+                             "time": 0, "deps": deps, "ops": ops})
+        deps = [decode_change_columns(buf)["hash"]]
+        buffers.append(buf)
+    return buffers
+
+
+def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
+    """The real backend.applyChanges contract at farm scale: binary changes
+    in, reference-format patches out, with a per-phase breakdown
+    (decode / walk / gate+transcode / pack / device / visibility /
+    patch_assembly)."""
+    from automerge_tpu.profiling import PhaseProfile, use_profile
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    buffers = _make_change_stream(rounds, ops_per_round, seed)
+    farm = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
+
+    # warm-up on a throwaway farm so jit compiles are excluded
+    warm = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
+    warm.apply_changes([[buffers[0]]] * num_docs)
+
+    prof = PhaseProfile()
+    start = time.perf_counter()
+    with use_profile(prof):
+        for buf in buffers:
+            farm.apply_changes([[buf]] * num_docs)
+    elapsed = time.perf_counter() - start
+
+    total_ops = num_docs * rounds * ops_per_round
+    return {
+        "ops_per_sec": total_ops / elapsed,
+        "elapsed_s": elapsed,
+        "phases": {
+            name: round(entry["total_s"], 4)
+            for name, entry in prof.as_dict().items()
+        },
+    }
+
+
 def bench_python(num_docs, rounds, ops_per_round, seed=0):
     """Sequential reference-parity engine on the same per-doc workload shape
     (measured on a small sample, reported per-op)."""
@@ -132,6 +195,9 @@ def _child_main():
     ops_per_round = int(os.environ.get("BENCH_OPS", "64"))
     capacity = rounds * ops_per_round
     result = bench_device(num_docs, capacity, rounds, ops_per_round)
+    e2e_docs = int(os.environ.get("BENCH_E2E_DOCS", "1024"))
+    if e2e_docs > 0:
+        result["end_to_end"] = bench_end_to_end(e2e_docs, rounds, ops_per_round)
     print("BENCH_RESULT " + json.dumps(result))
 
 
@@ -229,6 +295,13 @@ def main():
         "vs_baseline": round(result["ops_per_sec"] / py_ops_per_sec, 2),
         "backend": result["backend"],
     }
+    if "end_to_end" in result:
+        e2e = result["end_to_end"]
+        out["end_to_end"] = {
+            "ops_per_sec": round(e2e["ops_per_sec"]),
+            "vs_baseline": round(e2e["ops_per_sec"] / py_ops_per_sec, 2),
+            "phases_s": e2e["phases"],
+        }
     if errors:
         out["retried"] = len(errors)
     print(json.dumps(out))

@@ -263,6 +263,67 @@ int64_t am_bool_encode(const uint8_t* values, size_t n, uint8_t* out, size_t cap
   return static_cast<int64_t>(w.pos);
 }
 
+// ---- String RLE columns ---------------------------------------------------
+
+// Decodes a string-RLE column (RLE records whose values are length-prefixed
+// UTF-8 strings). Output: `blob` receives the string bytes; offs[2*i] and
+// offs[2*i+1] are the [start, end) range of row i's string in blob, or -1/-1
+// for null. Repeated runs share one blob range. Returns the number of rows,
+// or a negative error code.
+int64_t am_strrle_decode(const uint8_t* buf, size_t len,
+                         uint8_t* blob, size_t blob_cap,
+                         int64_t* offs, size_t cap) {
+  Reader r{buf, len};
+  size_t n = 0;
+  size_t blob_pos = 0;
+  while (!r.done()) {
+    int64_t count;
+    if (!r.read_sleb(&count)) return ERR_TRUNCATED;
+    if (count > 0) {
+      uint64_t slen;
+      if (!r.read_uleb(&slen)) return ERR_TRUNCATED;
+      if (r.pos + slen > r.len) return ERR_TRUNCATED;
+      if (blob_pos + slen > blob_cap) return ERR_OVERFLOW;
+      std::memcpy(blob + blob_pos, r.buf + r.pos, slen);
+      r.pos += slen;
+      int64_t start = static_cast<int64_t>(blob_pos);
+      int64_t end = static_cast<int64_t>(blob_pos + slen);
+      blob_pos += slen;
+      if (n + count > cap) return ERR_OVERFLOW;
+      for (int64_t i = 0; i < count; i++) {
+        offs[2 * n] = start;
+        offs[2 * n + 1] = end;
+        n++;
+      }
+    } else if (count < 0) {
+      for (int64_t i = 0; i < -count; i++) {
+        uint64_t slen;
+        if (!r.read_uleb(&slen)) return ERR_TRUNCATED;
+        if (r.pos + slen > r.len) return ERR_TRUNCATED;
+        if (blob_pos + slen > blob_cap) return ERR_OVERFLOW;
+        if (n >= cap) return ERR_OVERFLOW;
+        std::memcpy(blob + blob_pos, r.buf + r.pos, slen);
+        r.pos += slen;
+        offs[2 * n] = static_cast<int64_t>(blob_pos);
+        offs[2 * n + 1] = static_cast<int64_t>(blob_pos + slen);
+        blob_pos += slen;
+        n++;
+      }
+    } else {
+      uint64_t nulls;
+      if (!r.read_uleb(&nulls)) return ERR_TRUNCATED;
+      if (nulls == 0) return ERR_INVALID;
+      if (n + nulls > cap) return ERR_OVERFLOW;
+      for (uint64_t i = 0; i < nulls; i++) {
+        offs[2 * n] = -1;
+        offs[2 * n + 1] = -1;
+        n++;
+      }
+    }
+  }
+  return static_cast<int64_t>(n);
+}
+
 // ---- LEB128 batch helpers -------------------------------------------------
 
 int64_t am_uleb_decode_batch(const uint8_t* buf, size_t len, int64_t* out, size_t cap) {
