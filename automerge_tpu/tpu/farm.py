@@ -58,7 +58,7 @@ from .engine import (
     PAD_KEY,
     changes_from_numpy,
 )
-from .transcode import _Interner, actor_rank_table
+from .transcode import _Interner, _MAX_SLOTS, actor_rank_table
 
 
 class ValueCell(NamedTuple):
@@ -97,9 +97,11 @@ class TpuDocFarm:
         self.num_docs = num_docs
         self.engine = BatchedMapEngine(num_docs, capacity)
         # interners are shared across the batch: actor ids, (objectId, key)
-        # slots and scalar values are global tables, document state is not
-        self.actors = _Interner()
-        self.slots = _Interner()
+        # slots and scalar values are global tables, document state is not.
+        # Caps guard the merge-key packing ranges (slot << 44 | ctr << 20 |
+        # actor): an overflowing table would silently corrupt sort order.
+        self.actors = _Interner(max_size=1 << ACTOR_BITS, name="actor")
+        self.slots = _Interner(max_size=_MAX_SLOTS, name="slot")
         self.values = _Interner()
         # per-document host state
         self.object_meta = [{"_root": dict(_ROOT_META)} for _ in range(num_docs)]
@@ -366,7 +368,14 @@ class TpuDocFarm:
                 close(run)
                 run = None
                 last_batch = gate_batch
-            key = op["key"]
+            key = op.get("key")
+            if key is None or op.get("insert") or op.get("elemId") is not None:
+                # list/text ops never produce map-key cutoffs (docs touching
+                # them are served by the reference walk); a list op here can
+                # only mean a new op kind leaked in — close the run safely
+                close(run)
+                run = None
+                continue
             obj = op["obj"]
             lam = (ctr, actor)
             preds = []
@@ -461,23 +470,33 @@ class TpuDocFarm:
         )
 
     def _prevalidate_limits(self, d: int, decoded_changes) -> None:
-        """Raises the farm's packing-limit errors BEFORE the embedded walk
-        commits anything, so a failed apply leaves walk and device state
-        consistent (the walk has no such limits and would otherwise commit
-        changes the device path then rejects)."""
+        """Raises the farm's packing-limit errors BEFORE anything commits, so
+        a failed apply leaves all state untouched.
+
+        Every op counter must stay below 2^24: the merge key packs
+        (slot << 44 | ctr << 20 | actor) for ALL ops (engine._merge_key), not
+        only inserts. The element-capacity estimate counts inserts from this
+        delivery plus the queue (queued changes may become ready and apply in
+        the same call), and skips changes already applied (duplicate
+        deliveries never re-apply, so their inserts must not trigger a
+        spurious rejection)."""
         from . import rga
 
         inserts = 0
-        for change in decoded_changes:
+        seen = set()
+        for change in list(decoded_changes) + list(self.queue[d]):
+            if change["hash"] in self.change_index_by_hash[d] or change["hash"] in seen:
+                continue
+            seen.add(change["hash"])
             ctr = change["startOp"]
             for op in change["ops"]:
+                if ctr >= rga.MAX_COUNTER:
+                    raise ValueError(
+                        f"op counter {ctr} exceeds the rank kernel's "
+                        "packing range"
+                    )
                 if op.get("insert"):
                     inserts += 1
-                    if ctr >= rga.MAX_COUNTER:
-                        raise ValueError(
-                            f"op counter {ctr} exceeds the rank kernel's "
-                            "packing range"
-                        )
                 ctr += 1
         if int(self.num_elems[d]) + inserts > rga.MAX_ELEMS:
             raise ValueError(
@@ -516,6 +535,10 @@ class TpuDocFarm:
                     decoded.append(change)
                 per_doc_decoded.append(decoded)
 
+        for d, decoded in enumerate(per_doc_decoded):
+            if decoded or self.queue[d]:
+                self._prevalidate_limits(d, decoded)
+
         # list/text-targeting docs route through the reference walk, whose
         # patch is authoritative for them (byte-exact edit streams; see
         # module docstring). Run it BEFORE the farm's own gate so error
@@ -526,7 +549,6 @@ class TpuDocFarm:
                 if decoded and (
                     self.exact[d] is not None or self._targets_list(decoded)
                 ):
-                    self._prevalidate_limits(d, decoded)
                     self._ensure_exact(d)
                     exact_patches[d] = self.exact[d].apply_changes(
                         [c["buffer"] for c in decoded], is_local

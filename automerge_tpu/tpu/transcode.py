@@ -24,6 +24,7 @@ from .engine import (
     ACTION_DEL,
     ACTION_INC,
     ACTION_SET,
+    ACTOR_BITS,
     PAD_KEY,
     ChangeOpsBatch,
     changes_from_numpy,
@@ -34,8 +35,11 @@ _COUNTER_TAG = object()
 
 # Slot ids ride the high bits of the engine's packed int64 merge key
 # (slot << 44 | opid): 63 value bits - 44 opid bits = 19 bits of slot before
-# the sign bit flips and the sorted-table invariant silently breaks.
+# the sign bit flips and the sorted-table invariant silently breaks. The
+# opid field itself is (counter << 20 | actor), so counters are capped at
+# 2^24 and actor intern indexes at 2^20.
 _MAX_SLOTS = 1 << 19
+_MAX_COUNTER = 1 << 24
 
 
 class ChildRef(NamedTuple):
@@ -59,9 +63,15 @@ def actor_rank_table(actors, pad_to=None):
 
 
 class _Interner:
-    def __init__(self):
+    """Append-only value->int table. `max_size` guards packing ranges: slot
+    ids ride the high bits of the engine's int64 merge key, so an unchecked
+    table would silently corrupt the sorted-table invariant past 2^19."""
+
+    def __init__(self, max_size=None, name="intern"):
         self.table = []
         self.index = {}
+        self.max_size = max_size
+        self.name = name
 
     def intern(self, value) -> int:
         # Key by (class, value): Python equates 1 == True and
@@ -75,6 +85,11 @@ class _Interner:
             idx = self.index.get(key)
         if idx is None:
             idx = len(self.table)
+            if self.max_size is not None and idx >= self.max_size:
+                raise ValueError(
+                    f"{self.name} table overflow: more than {self.max_size} "
+                    "distinct entries in batch"
+                )
             self.table.append(value)
             self.index[key] = idx
         return idx
@@ -88,25 +103,30 @@ class BatchTranscoder:
     packs change ops into ChangeOpsBatch tensors."""
 
     def __init__(self):
-        self.actors = _Interner()
-        self.slots = _Interner()  # (objectId, key) pair -> int slot id
+        self.actors = _Interner(max_size=1 << ACTOR_BITS, name="actor")
+        self.slots = _Interner(max_size=_MAX_SLOTS, name="slot")
         self.values = _Interner()
         self.object_types = {"_root": "map"}  # objectId -> map | table
 
     def pack_opid_str(self, op_id: str) -> int:
         p = parse_op_id(op_id)
+        if p.counter >= _MAX_COUNTER:
+            raise ValueError(
+                f"op counter {p.counter} exceeds the merge-key packing range"
+            )
         return (p.counter << 20) | self.actors.intern(p.actor_id)
 
     def slot_id(self, obj: str, key: str) -> int:
-        slot = self.slots.intern((obj, key))
-        if slot >= _MAX_SLOTS:
-            raise ValueError("slot table overflow: > 2^19 (object, key) pairs in batch")
-        return slot
+        return self.slots.intern((obj, key))
 
     def op_row(self, op: dict, op_counter: int, actor: str):
         """Converts one map-family change op dict (frontend format) into a
         dense row (slot, op, action, value, pred). Supports set/inc/del on
         maps and table rows, plus makeMap/makeTable child creation."""
+        if op_counter >= _MAX_COUNTER:
+            raise ValueError(
+                f"op counter {op_counter} exceeds the merge-key packing range"
+            )
         packed_id = (op_counter << 20) | self.actors.intern(actor)
         slot = self.slot_id(op.get("obj", "_root"), op["key"])
         pred = self.pack_opid_str(op["pred"][0]) if op.get("pred") else -1

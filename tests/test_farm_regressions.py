@@ -1,0 +1,136 @@
+"""Regression tests for farm packing-limit and routing holes (round-2
+ADVICE findings): each test fails on the pre-fix code.
+
+- interner caps: the raw _Interner used by the farm bypassed the 2^19 slot
+  guard that only existed in BatchTranscoder.slot_id
+- map-op counters >= 2^24 silently corrupted the engine's merge-key sort
+  order (the guard only covered insert ops)
+- _prevalidate_limits counted duplicate deliveries' inserts (spurious
+  rejections) and ignored queued changes (mid-commit failures later)
+- a queued list-targeting change released by a later map-only delivery
+  bypassed the list walk and crashed patch assembly
+"""
+import pytest
+
+from automerge_tpu.opset import OpSet
+from automerge_tpu.tpu import rga
+from automerge_tpu.tpu.farm import TpuDocFarm
+from automerge_tpu.tpu.transcode import _Interner
+from automerge_tpu.columnar import decode_change_columns, encode_change
+
+
+def make_change(actor, seq, start_op, deps, ops):
+    buf = encode_change(
+        {"actor": actor, "seq": seq, "startOp": start_op, "time": 0,
+         "deps": sorted(deps), "ops": ops}
+    )
+    return buf, decode_change_columns(buf)["hash"]
+
+
+def test_interner_max_size_enforced():
+    interner = _Interner(max_size=2, name="slot")
+    assert interner.intern("a") == 0
+    assert interner.intern("b") == 1
+    assert interner.intern("a") == 0  # existing entries still resolve
+    with pytest.raises(ValueError, match="slot table overflow"):
+        interner.intern("c")
+
+
+def test_farm_interners_are_capped():
+    farm = TpuDocFarm(1)
+    assert farm.slots.max_size == 1 << 19
+    assert farm.actors.max_size == 1 << 20
+
+
+def test_map_op_counter_beyond_packing_range_rejected():
+    farm = TpuDocFarm(1)
+    buf, _ = make_change(
+        "aaaaaaaa", 1, 1, [],
+        [{"action": "set", "obj": "_root", "key": "a", "value": 1, "pred": []}],
+    )
+    farm.apply_changes([[buf]])
+    # startOp at 2^24 overflows the (slot << 44 | ctr << 20 | actor) packing
+    big, _ = make_change(
+        "aaaaaaaa", 2, 1 << 24, [farm.get_heads(0)[0]],
+        [{"action": "set", "obj": "_root", "key": "b", "value": 2, "pred": []}],
+    )
+    with pytest.raises(ValueError, match="packing range"):
+        farm.apply_changes([[big]])
+    # nothing committed: the doc still has exactly one applied change
+    assert len(farm.get_all_changes(0)) == 1
+    patch = farm.get_patch(0)
+    assert set(patch["diffs"]["props"]) == {"a"}
+
+
+def _insert_ops(n, after=None):
+    ops = []
+    ref = after
+    for _ in range(n):
+        ops.append({"action": "set", "obj": "1@aaaaaaaa", "elemId": ref or "_head",
+                    "insert": True, "value": "x", "pred": []})
+        ref = None  # consecutive inserts chain on the previous op implicitly
+    return ops
+
+
+def test_duplicate_delivery_does_not_count_toward_elem_budget(monkeypatch):
+    monkeypatch.setattr(rga, "MAX_ELEMS", 4)
+    farm = TpuDocFarm(1)
+    opset = OpSet()
+    buf1, h1 = make_change(
+        "aaaaaaaa", 1, 1, [], [{"action": "makeList", "obj": "_root", "key": "l", "pred": []}]
+    )
+    buf2, _ = make_change("aaaaaaaa", 2, 2, [h1], _insert_ops(3))
+    farm.apply_changes([[buf1]])
+    farm.apply_changes([[buf2]])
+    opset.apply_changes([buf1, buf2])
+    # re-delivering the same change must be a no-op, not a capacity error
+    patch = farm.apply_changes([[buf2]])[0]
+    expected = opset.apply_changes([buf2])
+    assert patch == expected
+
+
+def test_queued_inserts_count_toward_elem_budget(monkeypatch):
+    monkeypatch.setattr(rga, "MAX_ELEMS", 4)
+    farm = TpuDocFarm(1)
+    buf1, h1 = make_change(
+        "aaaaaaaa", 1, 1, [], [{"action": "makeList", "obj": "_root", "key": "l", "pred": []}]
+    )
+    farm.apply_changes([[buf1]])
+    # queued change with 2 inserts (dep never delivered)
+    missing_dep = "0000000000000000000000000000000000000000000000000000000000000000"
+    qbuf, _ = make_change("bbbbbbbb", 1, 10, [missing_dep], _insert_ops(2))
+    farm.apply_changes([[qbuf]])
+    assert farm.get_patch(0)["pendingChanges"] == 1
+    # 3 more inserts would pass alone (0 applied + 3 <= 4) but must be
+    # rejected up front: the queued 2 could become ready in the same call
+    buf3, _ = make_change("aaaaaaaa", 2, 2, [h1], _insert_ops(3))
+    with pytest.raises(ValueError, match="list elements"):
+        farm.apply_changes([[buf3]])
+    assert len(farm.get_all_changes(0)) == 1  # nothing committed
+
+
+def test_queued_list_change_released_by_map_only_delivery():
+    """A list-targeting change sitting in the queue must keep producing
+    reference-exact patches when a later map-only delivery releases it
+    (pins the queue-release routing that patch assembly relies on)."""
+    farm = TpuDocFarm(1)
+    opset = OpSet()
+    buf1, h1 = make_change(
+        "aaaaaaaa", 1, 1, [],
+        [{"action": "set", "obj": "_root", "key": "a", "value": 1, "pred": []}],
+    )
+    buf2, h2 = make_change(
+        "bbbbbbbb", 1, 2, [h1],
+        [{"action": "makeList", "obj": "_root", "key": "l", "pred": []}]
+        + [{"action": "set", "obj": "2@bbbbbbbb", "elemId": "_head",
+            "insert": True, "value": "x", "pred": []}],
+    )
+    # deliver the list change first: it queues on the missing dep
+    p_farm = farm.apply_changes([[buf2]])[0]
+    p_ref = opset.apply_changes([buf2])
+    assert p_farm == p_ref
+    # the map-only delivery releases it; both must apply atomically
+    p_farm = farm.apply_changes([[buf1]])[0]
+    p_ref = opset.apply_changes([buf1])
+    assert p_farm == p_ref
+    assert farm.get_patch(0) == opset.get_patch()
