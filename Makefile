@@ -1,0 +1,16 @@
+# Repo-level developer targets. The analyzer and tests force
+# JAX_PLATFORMS=cpu so they run on any host (no TPU required); amlint
+# itself is stdlib-only and never initialises jax.
+
+PY ?= python
+
+.PHONY: lint test native
+
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C native

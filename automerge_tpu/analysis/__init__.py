@@ -1,0 +1,90 @@
+"""amlint — repo-native static analysis for automerge_tpu.
+
+The TPU backend's correctness hangs on invariants the type system cannot
+see: the merge-key bit layout (``slot << 44 | ctr << 20 | actor``), the
+interner packing caps, the purity rules jax imposes on traced code, and the
+host/device module split. This package enforces them over the AST on every
+commit (tests/test_static_analysis.py is the tier-1 gate).
+
+Library API::
+
+    from automerge_tpu.analysis import run_analysis
+    findings = run_analysis(["automerge_tpu"])       # unsuppressed only
+    everything = run_analysis(paths, include_suppressed=True)
+
+CLI::
+
+    python -m automerge_tpu.analysis [paths...]      # exit 1 on findings
+    python -m automerge_tpu.analysis --list-rules
+
+Rule families (see core.RULES for the catalog):
+
+- **AM1xx packing**: bit-layout constant consistency (AM101), magic
+  shift/mask literals (AM102), interner caps (AM103), packing-limit
+  diagnostic wording (AM104).
+- **AM2xx tracer safety**: Python control flow on traced values (AM201),
+  host calls on traced values (AM202), dtype-less array construction
+  (AM203), captured-state mutation in traced code (AM204).
+- **AM3xx boundary**: host-only modules importing the device layer
+  (AM301), hidden host syncs inside device profiling phases (AM302).
+
+Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
+on the line above; ``# amlint: disable-file=AM203`` for a whole file.
+
+This package is stdlib-only by design: importing it (and running the CLI)
+must never initialise jax, so the gate runs on any host.
+"""
+from __future__ import annotations
+
+import tokenize
+from pathlib import Path
+
+from . import boundary, packing, tracer
+from .core import RULES, FileContext, Finding, collect_files
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "run_analysis",
+    "format_report",
+    "default_target",
+]
+
+
+def default_target() -> Path:
+    """The automerge_tpu package directory (the CLI's default scan root)."""
+    return Path(__file__).resolve().parent.parent
+
+
+def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
+    """Runs every rule family over the given files/directories.
+
+    Returns findings sorted by (path, line, rule). Suppressed findings are
+    dropped unless ``include_suppressed`` is set (they then carry
+    ``suppressed=True``). Unparseable files yield an AM000 finding instead
+    of raising."""
+    ctxs: list[FileContext] = []
+    findings: list[Finding] = []
+    for path, display in collect_files([Path(p) for p in paths]):
+        try:
+            ctxs.append(FileContext(path, display))
+        except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
+            findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
+                                    0, f"could not parse: {exc}"))
+    for family in (packing, tracer, boundary):
+        findings.extend(family.check(ctxs))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
+    if not include_suppressed:
+        findings = [f for f in findings if not f.suppressed]
+    return findings
+
+
+def format_report(findings: list[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    active = sum(1 for f in findings if not f.suppressed)
+    suppressed = len(findings) - active
+    tail = f"{active} finding(s)"
+    if suppressed:
+        tail += f", {suppressed} suppressed"
+    lines.append(tail)
+    return "\n".join(lines)

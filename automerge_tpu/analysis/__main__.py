@@ -1,0 +1,53 @@
+"""CLI entry point: ``python -m automerge_tpu.analysis [paths...]``.
+
+Exit codes: 0 = no unsuppressed findings, 1 = findings, 2 = bad usage.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULES, default_target, format_report, run_analysis
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m automerge_tpu.analysis",
+        description="amlint: packing-invariant, tracer-safety and "
+                    "host/device boundary checks for automerge_tpu",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to analyze (default: the installed "
+             "automerge_tpu package)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings in the report (they do not "
+             "affect the exit code)",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the report; exit code only",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (family, summary) in sorted(RULES.items()):
+            print(f"{rule_id}  [{family:8s}] {summary}")
+        return 0
+
+    paths = args.paths or [str(default_target())]
+    findings = run_analysis(paths, include_suppressed=args.show_suppressed)
+    active = [f for f in findings if not f.suppressed]
+    if not args.quiet:
+        print(format_report(findings))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,673 @@
+"""AM2xx — tracer-safety rules.
+
+JAX tracing imposes purity rules the Python type system cannot see: code
+reachable from a ``jax.jit`` / ``jax.vmap`` / Pallas entry point receives
+tracers, and Python-level branching on a tracer, host-library calls on a
+tracer, or mutation of captured host state all either raise at trace time
+or (worse) silently bake one traced execution into the compiled program.
+
+The checker builds a per-module view of traced code:
+
+- **roots**: functions decorated with jit-like decorators (``@jax.jit``,
+  ``@partial(jax.jit, ...)``, ``@jax.vmap``), with ``static_argnums`` /
+  ``static_argnames`` honoured, plus functions *referenced* as arguments of
+  tracing combinators (``jax.vmap(f)``, ``jax.lax.fori_loop(_, _, f, _)``,
+  ``pl.pallas_call(f)``, ``jax.lax.scan(f, ...)``) whose parameters are all
+  traced (``partial``-bound arguments are host constants and stay static);
+- **taint**: inside a traced function, parameters are traced; taint
+  propagates through expressions and assignments, and is *blocked* by the
+  static accessors (``.shape``, ``.dtype``, ``.ndim``, ``len()``) — shape
+  math is host-side and branching on it is legal;
+- **interprocedural**: a direct call from traced code taints the callee's
+  parameters positionally, so shared helpers are checked under the taint
+  they actually receive.
+
+Rules:
+- AM201: ``if``/``while``/``assert``/``and``/``or``/ternary/``for`` over a
+  traced value (TracerBoolConversionError at runtime, or a silently
+  specialised branch).
+- AM202: host escapes — ``np.*`` calls, ``int()``/``float()``/``bool()``,
+  ``.item()``/``.tolist()`` — applied to a traced value.
+- AM203: dtype-less ``np.zeros/ones/empty/full/array`` (and jnp
+  equivalents) in modules that import jax: default dtypes differ between
+  hosts and backends (int32 vs int64, x64 flag), which corrupts packed
+  int64 opids — transcode hot paths must pin every dtype.
+- AM204: mutation of captured host state (``global``/``nonlocal``,
+  ``obj.attr = ...`` or ``.append()``-style calls on closure/module names)
+  inside traced code — traced mutations run once at trace time, not per
+  call.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, dotted_name
+
+_JIT_DECORATORS = {"jit", "vmap", "pmap"}
+_COMBINATORS = {
+    "jit", "vmap", "pmap", "scan", "fori_loop", "while_loop", "cond",
+    "switch", "pallas_call", "reduce", "associative_scan", "remat",
+    "checkpoint", "grad", "value_and_grad", "custom_vjp", "custom_jvp",
+}
+_JAX_ROOTS = {"jax", "jnp", "lax", "pl", "pltpu", "pallas"}
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type", "sharding", "aval"}
+_STATIC_CALLS = {"len", "range", "isinstance", "type", "enumerate", "zip"}
+_COERCIONS = {"int", "float", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop", "clear",
+             "remove", "setdefault", "discard", "popitem"}
+_DTYPE_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1}
+
+
+def _np_aliases(tree: ast.Module) -> set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy":
+                    out.add(alias.asname or "numpy")
+    return out
+
+
+def _jnp_aliases(tree: ast.Module) -> set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.numpy":
+                    out.add(alias.asname or "jax.numpy")
+    return out
+
+
+def _import_aliases(tree: ast.Module) -> set[str]:
+    """Every top-level name bound by an import (module aliases and
+    from-imported names): functional APIs like jnp.append are not captured
+    host state."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _imports_jax(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax" or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _is_combinator_call(func: ast.expr) -> bool:
+    name = dotted_name(func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if parts[-1] not in _COMBINATORS:
+        return False
+    return len(parts) == 1 or any(p in _JAX_ROOTS for p in parts[:-1])
+
+
+def _is_jit_like(node: ast.expr) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    return parts[-1] in _JIT_DECORATORS and (
+        len(parts) == 1 or any(p in _JAX_ROOTS for p in parts[:-1])
+    )
+
+
+def _const_strings(node: ast.expr) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for elt in node.elts:
+            out |= _const_strings(elt)
+        return out
+    return set()
+
+
+def _const_ints(node: ast.expr) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for elt in node.elts:
+            out |= _const_ints(elt)
+        return out
+    return set()
+
+
+def _decorator_statics(dec: ast.expr):
+    """(is_traced, static_argnums, static_argnames) for a decorator node."""
+    if _is_jit_like(dec):
+        return True, set(), set()
+    if isinstance(dec, ast.Call):
+        func_name = dotted_name(dec.func)
+        target_is_jit = False
+        if func_name and func_name.split(".")[-1] == "partial" and dec.args:
+            target_is_jit = _is_jit_like(dec.args[0])
+        elif _is_jit_like(dec.func):
+            target_is_jit = True
+        if target_is_jit:
+            nums: set[int] = set()
+            names: set[str] = set()
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    nums |= _const_ints(kw.value)
+                elif kw.arg == "static_argnames":
+                    names |= _const_strings(kw.value)
+            return True, nums, names
+    return False, set(), set()
+
+
+def _param_names(fn) -> list[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    names += [a.arg for a in args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _assigned_names(fn) -> set[str]:
+    """Every name bound anywhere inside the function body (its locals)."""
+    out: set[str] = set(_param_names(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Name,)) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node is not fn:
+                out.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+class _ModuleChecker:
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.tree = ctx.tree
+        self.np_aliases = _np_aliases(ctx.tree)
+        self.jnp_aliases = _jnp_aliases(ctx.tree)
+        self.import_aliases = _import_aliases(ctx.tree)
+        self.findings: list[Finding] = []
+        self._emitted: set[tuple[str, int, int]] = set()
+        self.module_funcs = {
+            n.name: n
+            for n in self.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # (func name, frozenset of tainted params) already analyzed
+        self._done: set[tuple[int, frozenset]] = set()
+        self.traced_names: set[str] = set()
+
+    # ------------------------------------------------------------------ #
+
+    def run(self) -> list[Finding]:
+        worklist: list[tuple[ast.AST, frozenset]] = []
+
+        for fn in self.module_funcs.values():
+            for dec in fn.decorator_list:
+                traced, nums, names = _decorator_statics(dec)
+                if traced:
+                    params = _param_names(fn)
+                    tainted = frozenset(
+                        p for i, p in enumerate(params)
+                        if i not in nums and p not in names
+                    )
+                    worklist.append((fn, tainted))
+                    self.traced_names.add(fn.name)
+                    break
+
+        # module functions referenced as combinator arguments anywhere
+        for fn, exempt_names, exempt_count in self._combinator_refs(self.tree):
+            params = _param_names(fn)
+            tainted = frozenset(
+                p for i, p in enumerate(params)
+                if i >= exempt_count and p not in exempt_names
+            )
+            worklist.append((fn, tainted))
+            self.traced_names.add(fn.name)
+
+        # nested defs passed to combinators inside otherwise-host functions
+        # (e.g. `return jax.jit(impl, ...)` in a factory) are trace roots too
+        module_fn_nodes = set(map(id, self.module_funcs.values()))
+        for fn in self.module_funcs.values():
+            nested = {
+                n.name: n for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            }
+            if not nested:
+                continue
+            for sub, exempt_names, exempt_count in self._combinator_refs(fn, nested):
+                if id(sub) in module_fn_nodes:
+                    continue  # already handled by the module-wide scan
+                params = _param_names(sub)
+                tainted = frozenset(
+                    p for i, p in enumerate(params)
+                    if i >= exempt_count and p not in exempt_names
+                )
+                worklist.append((sub, tainted))
+
+        while worklist:
+            fn, tainted = worklist.pop()
+            key = (id(fn), tainted)
+            if key in self._done:
+                continue
+            self._done.add(key)
+            self._analyze_function(fn, tainted, worklist)
+        return self.findings
+
+    def _combinator_refs(self, scope: ast.AST, local_funcs=None):
+        """(function node, partial-bound kwnames, partial-bound positional
+        count) for every module/nested function referenced as an argument
+        of a tracing combinator within `scope`."""
+        funcs = dict(self.module_funcs)
+        if local_funcs:
+            funcs.update(local_funcs)
+        refs = []
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Call) and _is_combinator_call(node.func)):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in funcs:
+                    refs.append((funcs[arg.id], set(), 0))
+                elif isinstance(arg, ast.Call):
+                    fname = dotted_name(arg.func)
+                    if (
+                        fname
+                        and fname.split(".")[-1] == "partial"
+                        and arg.args
+                        and isinstance(arg.args[0], ast.Name)
+                        and arg.args[0].id in funcs
+                    ):
+                        bound = {kw.arg for kw in arg.keywords if kw.arg}
+                        refs.append(
+                            (funcs[arg.args[0].id], bound, len(arg.args) - 1)
+                        )
+        return refs
+
+    # ------------------------------------------------------------------ #
+
+    def _emit(self, rule_id: str, node: ast.AST, message: str) -> None:
+        key = (rule_id, getattr(node, "lineno", 1), getattr(node, "col_offset", 0))
+        if key not in self._emitted:
+            self._emitted.add(key)
+            self.findings.append(self.ctx.finding(rule_id, node, message))
+
+    def _analyze_function(self, fn, tainted: frozenset, worklist) -> None:
+        locals_ = _assigned_names(fn)
+        nested = {
+            n.name: n for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+        }
+        env = set(tainted)
+        state = _FnState(self, fn, locals_, nested, worklist)
+        # pass 1: propagate taint (loops make later lines feed earlier ones);
+        # pass 2: report with the stable env
+        state.walk_block(fn.body, env, report=False)
+        state.walk_block(fn.body, env, report=True)
+
+        # nested functions referenced in combinators run traced with the
+        # enclosing env visible as closure state
+        for sub, exempt_names, exempt_count in self._combinator_refs(fn, nested):
+            if sub is fn:
+                continue
+            params = _param_names(sub)
+            sub_tainted = frozenset(
+                p for i, p in enumerate(params)
+                if i >= exempt_count and p not in exempt_names
+            ) | frozenset(n for n in env if n not in _assigned_names(sub))
+            key = (id(sub), sub_tainted)
+            if key not in self._done:
+                self._done.add(key)
+                self._analyze_function(sub, sub_tainted, worklist)
+        # pl.when-decorated nested defs execute inside the trace
+        for sub in nested.values():
+            for dec in sub.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    (dotted_name(dec.func) or "").split(".")[-1] == "when"
+                ):
+                    sub_tainted = frozenset(
+                        n for n in env if n not in _assigned_names(sub)
+                    )
+                    key = (id(sub), sub_tainted)
+                    if key not in self._done:
+                        self._done.add(key)
+                        self._analyze_function(sub, sub_tainted, worklist)
+
+
+class _FnState:
+    """Per-function walk: statement-ordered taint propagation + findings."""
+
+    def __init__(self, mod: _ModuleChecker, fn, locals_, nested, worklist):
+        self.mod = mod
+        self.fn = fn
+        self.locals = locals_
+        self.nested = nested
+        self.worklist = worklist
+        self.report = False
+
+    # ------------------------------ statements ------------------------ #
+
+    def walk_block(self, stmts, env: set, report: bool) -> None:
+        self.report = report
+        for stmt in stmts:
+            self.walk_stmt(stmt, env)
+
+    def walk_stmt(self, stmt, env: set) -> None:
+        mod = self.mod
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs handled by the module checker
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            t = self.taint(value, env) if value is not None else False
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for target in targets:
+                if isinstance(stmt, ast.AugAssign):
+                    t = t or self.taint(target, env)
+                self._bind(target, t, env)
+        elif isinstance(stmt, ast.If):
+            if self.taint(stmt.test, env) and self.report:
+                mod._emit("AM201", stmt,
+                          "Python-level `if` on a traced value inside traced "
+                          f"code ({self.fn.name}): use jnp.where/lax.cond")
+            for s in stmt.body + stmt.orelse:
+                self.walk_stmt(s, env)
+        elif isinstance(stmt, ast.While):
+            if self.taint(stmt.test, env) and self.report:
+                mod._emit("AM201", stmt,
+                          "Python-level `while` on a traced value inside "
+                          f"traced code ({self.fn.name}): use lax.while_loop")
+            for s in stmt.body + stmt.orelse:
+                self.walk_stmt(s, env)
+        elif isinstance(stmt, ast.Assert):
+            if self.taint(stmt.test, env) and self.report:
+                mod._emit("AM201", stmt,
+                          "assert on a traced value inside traced code "
+                          f"({self.fn.name}): use checkify or a host-side "
+                          "prevalidation pass")
+        elif isinstance(stmt, ast.For):
+            if self.taint(stmt.iter, env) and self.report:
+                mod._emit("AM201", stmt,
+                          "Python `for` over a traced value inside traced "
+                          f"code ({self.fn.name}): use lax.fori_loop/scan")
+            self._bind(stmt.target, self.taint(stmt.iter, env), env)
+            for s in stmt.body + stmt.orelse:
+                self.walk_stmt(s, env)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.taint(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, False, env)
+            for s in stmt.body:
+                self.walk_stmt(s, env)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body + stmt.orelse + stmt.finalbody:
+                self.walk_stmt(s, env)
+            for handler in stmt.handlers:
+                for s in handler.body:
+                    self.walk_stmt(s, env)
+        elif isinstance(stmt, (ast.Global, ast.Nonlocal)):
+            if self.report:
+                mod._emit("AM204", stmt,
+                          f"`{'global' if isinstance(stmt, ast.Global) else 'nonlocal'}`"
+                          " inside traced code mutates host state at trace "
+                          "time, not per execution")
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.taint(stmt.value, env)
+        elif isinstance(stmt, ast.Expr):
+            self.taint(stmt.value, env)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self.taint(stmt.exc, env)
+        # Import/Pass/Break/Continue/Delete: nothing to do
+
+    def _bind(self, target, tainted: bool, env: set) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                env.add(target.id)
+            else:
+                env.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tainted, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted, env)
+        elif isinstance(target, ast.Attribute):
+            base = target.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id not in self.locals
+                and self.report
+            ):
+                self.mod._emit(
+                    "AM204", target,
+                    f"assignment to `{base.id}.{target.attr}` mutates "
+                    "captured host state inside traced code",
+                )
+        # Subscript stores are allowed: pallas Ref writes (out_ref[...] = x)
+        # are the output idiom
+
+    # ------------------------------ expressions ------------------------ #
+
+    def taint(self, node, env: set) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                self.taint(node.value, env)
+                return False
+            return self.taint(node.value, env)
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            base_t = self.taint(base, env)
+            idx_t = self.taint(node.slice, env)
+            return base_t or idx_t
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, env)
+        if isinstance(node, ast.BoolOp):
+            parts = [self.taint(v, env) for v in node.values]
+            if any(parts) and self.report:
+                self.mod._emit(
+                    "AM201", node,
+                    "`and`/`or` coerces a traced value to bool inside traced "
+                    f"code ({self.fn.name}): use jnp.logical_and/or or &,|",
+                )
+            return any(parts)
+        if isinstance(node, ast.IfExp):
+            t = self.taint(node.test, env)
+            if t and self.report:
+                self.mod._emit(
+                    "AM201", node,
+                    "conditional expression on a traced value inside traced "
+                    f"code ({self.fn.name}): use jnp.where",
+                )
+            return t or self.taint(node.body, env) or self.taint(node.orelse, env)
+        if isinstance(node, (ast.BinOp,)):
+            return self.taint(node.left, env) | self.taint(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand, env)
+        if isinstance(node, ast.Compare):
+            t = self.taint(node.left, env)
+            for comp in node.comparators:
+                t |= self.taint(comp, env)
+            return t
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.taint(e, env) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(
+                self.taint(x, env) for x in (node.keys + node.values) if x
+            )
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value, env)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for sub in ast.iter_child_nodes(node):
+                self.taint(sub, env)
+            return False
+        if isinstance(node, ast.Slice):
+            return any(
+                self.taint(x, env)
+                for x in (node.lower, node.upper, node.step) if x
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            t = False
+            inner = set(env)
+            for gen in node.generators:
+                it = self.taint(gen.iter, inner)
+                t |= it
+                self._bind(gen.target, it, inner)
+                for cond in gen.ifs:
+                    if self.taint(cond, inner) and self.report:
+                        self.mod._emit(
+                            "AM201", cond,
+                            "comprehension filter on a traced value inside "
+                            f"traced code ({self.fn.name})",
+                        )
+            if isinstance(node, ast.DictComp):
+                t |= self.taint(node.key, inner) | self.taint(node.value, inner)
+            else:
+                t |= self.taint(node.elt, inner)
+            return t
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.taint(node.value, env)
+        if isinstance(node, ast.Yield):
+            return self.taint(node.value, env) if node.value else False
+        return False
+
+    def _call_taint(self, node: ast.Call, env: set) -> bool:
+        mod = self.mod
+        fname = dotted_name(node.func)
+        arg_taints = [self.taint(a, env) for a in node.args]
+        kw_taints = [self.taint(kw.value, env) for kw in node.keywords]
+        args_tainted = any(arg_taints) or any(kw_taints)
+
+        if fname in _STATIC_CALLS:
+            return False
+        last = fname.split(".")[-1] if fname else None
+
+        # host coercions on tracers
+        if fname in _COERCIONS:
+            if args_tainted and self.report:
+                mod._emit("AM202", node,
+                          f"`{fname}()` forces a traced value to a host "
+                          f"scalar inside traced code ({self.fn.name})")
+            return False
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_METHODS
+            and self.taint(node.func.value, env)
+        ):
+            if self.report:
+                mod._emit("AM202", node,
+                          f"`.{node.func.attr}()` transfers a traced value "
+                          f"to the host inside traced code ({self.fn.name})")
+            return False
+        # numpy on tracers
+        if fname:
+            root = fname.split(".")[0]
+            if root in mod.np_aliases and args_tainted:
+                if self.report:
+                    mod._emit("AM202", node,
+                              f"`{fname}` applies host numpy to a traced "
+                              f"value inside traced code ({self.fn.name}): "
+                              "use jax.numpy")
+                return True
+        # mutating method on a captured (non-local) name
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id not in self.locals
+            and node.func.value.id not in mod.import_aliases
+            and self.report
+        ):
+            mod._emit("AM204", node,
+                      f"`{node.func.value.id}.{node.func.attr}()` mutates "
+                      "captured host state inside traced code "
+                      f"({self.fn.name})")
+
+        # direct call into another module-level (or sibling nested)
+        # function: propagate taint positionally
+        callee = None
+        if isinstance(node.func, ast.Name):
+            callee = self.nested.get(node.func.id) or mod.module_funcs.get(
+                node.func.id
+            )
+        if callee is not None:
+            params = _param_names(callee)
+            tainted_params = frozenset(
+                params[i] for i, t in enumerate(arg_taints)
+                if t and i < len(params)
+            ) | frozenset(
+                kw.arg for kw, t in zip(node.keywords, kw_taints)
+                if t and kw.arg
+            )
+            if tainted_params:
+                self.worklist.append((callee, tainted_params))
+
+        func_taint = False
+        if isinstance(node.func, ast.Attribute):
+            func_taint = self.taint(node.func.value, env)
+        return args_tainted or func_taint
+
+
+# ---------------------------------------------------------------------- #
+# AM203 — dtype-less array construction (module-wide scan)
+
+def _check_dtypes(ctx: FileContext) -> list[Finding]:
+    if not _imports_jax(ctx.tree):
+        return []
+    np_like = _np_aliases(ctx.tree) | _jnp_aliases(ctx.tree) | {"jnp"}
+    findings: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname is None or "." not in fname:
+            continue
+        root, last = fname.split(".")[0], fname.split(".")[-1]
+        if root not in np_like or last not in _DTYPE_CTORS:
+            continue
+        dtype_pos = _DTYPE_CTORS[last]
+        has_dtype = len(node.args) > dtype_pos or any(
+            kw.arg == "dtype" for kw in node.keywords
+        )
+        if not has_dtype:
+            findings.append(ctx.finding(
+                "AM203", node,
+                f"`{fname}` without an explicit dtype: default dtypes vary "
+                "with platform and the x64 flag, which corrupts packed int64 "
+                "opids in transcode hot paths — pin the dtype",
+            ))
+    return findings
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        findings += _ModuleChecker(ctx).run()
+        findings += _check_dtypes(ctx)
+    return findings

@@ -8,6 +8,7 @@ This module is the swappable-backend contract: any engine implementing these
 functions (the pure-Python OpSet here, or the TPU batched engine in
 automerge_tpu.tpu) can serve the same frontend.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 from .columnar import encode_change

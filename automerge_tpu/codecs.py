@@ -13,6 +13,7 @@ bit-identical output for the same value sequences:
 - Boolean columns: alternating run lengths starting with false
   (encoding.js:1053).
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 MAX_SAFE_INTEGER = 2**53 - 1

@@ -6,6 +6,7 @@ container framing (magic bytes + SHA-256 checksum + chunk type), change
 chunk layout and document chunk layout. SHA-256 via hashlib, DEFLATE via
 zlib (raw streams).
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import struct

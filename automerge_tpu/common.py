@@ -3,6 +3,7 @@
 Mirrors the semantics of the reference implementation's shared utilities
 (/root/reference/src/common.js) with Python idioms.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import re

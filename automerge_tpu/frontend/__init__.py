@@ -6,6 +6,7 @@ message types: change requests (frontend -> backend) and patches (backend ->
 frontend); both are plain JSON-able dicts, so the backend can be the local
 pure-Python engine, the TPU batched engine, or a remote process.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import time as _time

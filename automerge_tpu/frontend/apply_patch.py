@@ -3,6 +3,7 @@
 Port of /root/reference/frontend/apply_patch.js. Conflict resolution picks
 the value with the greatest Lamport opId (apply_patch.js:57-77).
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 from ..common import lamport_compare_key, parse_op_id

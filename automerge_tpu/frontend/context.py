@@ -3,6 +3,7 @@ a change block, and optimistically applies the corresponding patch.
 
 Port of /root/reference/frontend/context.js.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import datetime as _dt

@@ -7,6 +7,7 @@ map/list objects produced by apply_patch.js). Documents are immutable
 outside of change blocks: Map/List subclass dict/list but refuse mutation
 unless instantiated as writable working copies by the patch interpreter.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import datetime as _dt

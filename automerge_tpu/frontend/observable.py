@@ -1,5 +1,6 @@
 """Per-object change subscription by patch-walking
 (port of /root/reference/frontend/observable.js)."""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 

@@ -4,6 +4,7 @@ The Python equivalent of the reference's ES6 Proxy layer
 (/root/reference/frontend/proxies.js): MapProxy/ListProxy translate Python
 mutation idioms (item assignment, append, slicing, del) into Context calls.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 from .context import get_elem_id

@@ -7,6 +7,7 @@ not been built; `available()` reports which path is active.
 
 Build with: make -C native   (or python -m automerge_tpu.native --build)
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import ctypes

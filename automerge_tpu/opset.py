@@ -22,6 +22,7 @@ Patch generation reproduces the reference's incremental patch state machine
 (updatePatchProperty, appendEdit/appendUpdate/convertInsertToUpdate,
 new.js:747-1040) exactly, so patches are bit-identical JSON.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 from .columnar import (

@@ -25,7 +25,7 @@ def make_mesh(devices=None, sp: int = 1) -> Mesh:
         shape = (n // sp, sp)
     else:
         shape = (n, 1)
-    dev_array = np.array(devices).reshape(shape)
+    dev_array = np.array(devices, dtype=object).reshape(shape)
     return Mesh(dev_array, ("dp", "sp"))
 
 

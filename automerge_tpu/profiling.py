@@ -18,6 +18,7 @@ single attribute test of overhead. A module-level `get_profile()` hands
 out the ambient profile installed by `use_profile()` so deep call sites
 (the farm, the engine) need no plumbing.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import contextlib

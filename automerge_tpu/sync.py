@@ -8,6 +8,7 @@ The batched multi-document variant (thousands of (doc, peer) pairs with
 device-side Bloom construction/query) lives in automerge_tpu.tpu.sync_batch;
 this module is the single-document protocol implementation.
 """
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 from math import ceil

@@ -102,6 +102,8 @@ class TpuDocFarm:
         # actor): an overflowing table would silently corrupt sort order.
         self.actors = _Interner(max_size=1 << ACTOR_BITS, name="actor")
         self.slots = _Interner(max_size=_MAX_SLOTS, name="slot")
+        # amlint: disable=AM103 — value ids are payloads, never packed into
+        # merge keys, so the table has no bit-field cap
         self.values = _Interner()
         # per-document host state
         self.object_meta = [{"_root": dict(_ROOT_META)} for _ in range(num_docs)]
@@ -251,10 +253,10 @@ class TpuDocFarm:
         action = op["action"]
 
         if op.get("insert"):
-            if ctr >= rga.MAX_COUNTER:
-                raise ValueError(
-                    f"op counter {ctr} exceeds the rank kernel's packing range"
-                )
+            # counter range is enforced batch-wide by _prevalidate_limits
+            # before any transcoding starts (the single enforcement point);
+            # this only restates the invariant for direct-row callers
+            assert ctr < rga.MAX_COUNTER, "op counter outside merge-key packing range"
             elem_id = f"{ctr}@{actor}"
             ref = op.get("elemId") or "_head"
             idx = int(self.num_elems[d])
@@ -479,7 +481,17 @@ class TpuDocFarm:
         delivery plus the queue (queued changes may become ready and apply in
         the same call), and skips changes already applied (duplicate
         deliveries never re-apply, so their inserts must not trigger a
-        spurious rejection)."""
+        spurious rejection).
+
+        Abort semantics are BATCH-WIDE: the pre-pass runs for every doc
+        before any doc's ops are transcoded or committed, so one over-limit
+        document fails the whole apply_changes call and every document in
+        the batch stays untouched. The queue estimate is deliberately
+        conservative — a permanently-stuck queued change with inserts keeps
+        shrinking the doc's effective element budget (readiness is
+        unknowable without running the causal gate), which can reject a
+        delivery that would have fit; split the batch to isolate such a
+        doc."""
         from . import rga
 
         inserts = 0
@@ -492,7 +504,7 @@ class TpuDocFarm:
             for op in change["ops"]:
                 if ctr >= rga.MAX_COUNTER:
                     raise ValueError(
-                        f"op counter {ctr} exceeds the rank kernel's "
+                        f"op counter {ctr} exceeds the merge-key "
                         "packing range"
                     )
                 if op.get("insert"):
@@ -535,8 +547,14 @@ class TpuDocFarm:
                     decoded.append(change)
                 per_doc_decoded.append(decoded)
 
+        # Docs receiving no changes this call skip prevalidation entirely:
+        # their queue was already validated at its original delivery and a
+        # queued change can only become ready when a NEW change for the same
+        # doc commits, so re-scanning the queue would be O(queue ops) of
+        # redundant work per call (ADVICE round 5). Docs that do receive
+        # changes still re-scan their queue inside _prevalidate_limits.
         for d, decoded in enumerate(per_doc_decoded):
-            if decoded or self.queue[d]:
+            if decoded:
                 self._prevalidate_limits(d, decoded)
 
         # list/text-targeting docs route through the reference walk, whose

@@ -159,8 +159,9 @@ def batched_rga_rank(parent, opid, valid, actor_rank):
     """
     if parent.shape[-1] > MAX_ELEMS:
         raise ValueError(
-            f"document element table exceeds MAX_ELEMS={MAX_ELEMS}; the "
-            "sibling-sort key packing would overflow int64"
+            f"document element table exceeds the rank kernel's "
+            f"MAX_ELEMS={MAX_ELEMS}; the sibling-sort key packing would "
+            "overflow int64"
         )
     remapped = remap_opid_actors(opid, actor_rank)
     return jax.vmap(_rga_rank_one_doc)(parent, remapped, valid)

@@ -29,6 +29,7 @@ from ..common import parse_op_id
 from .engine import (
     ACTION_DEL,
     ACTION_SET,
+    ACTOR_BITS,
     BatchedMapEngine,
     PAD_KEY,
     changes_from_numpy,
@@ -116,7 +117,7 @@ class BatchedTextEngine:
 
     def _pack(self, op_id: str) -> int:
         p = parse_op_id(op_id)
-        return (p.counter << 20) | self._actor(p.actor_id)
+        return (p.counter << ACTOR_BITS) | self._actor(p.actor_id)
 
     def _actor_rank(self) -> np.ndarray:
         """Lexicographic rank per actor intern index, padded to a power of
@@ -160,11 +161,11 @@ class BatchedTextEngine:
             for op, ctr, actor in doc_ops:
                 if ctr >= rga.MAX_COUNTER:
                     raise ValueError(
-                        f"op counter {ctr} exceeds the rank kernel's "
-                        f"{rga.MAX_COUNTER} packing range"
+                        f"op counter {ctr} exceeds the merge-key "
+                        "packing range"
                     )
                 op_id = f"{ctr}@{actor}"
-                packed = (ctr << 20) | self._actor(actor)
+                packed = (ctr << ACTOR_BITS) | self._actor(actor)
                 if op.get("insert"):
                     ref = op.get("elemId", "_head")
                     slot = int(self.num_elems[d])

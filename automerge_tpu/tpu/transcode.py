@@ -105,6 +105,8 @@ class BatchTranscoder:
     def __init__(self):
         self.actors = _Interner(max_size=1 << ACTOR_BITS, name="actor")
         self.slots = _Interner(max_size=_MAX_SLOTS, name="slot")
+        # amlint: disable=AM103 — value ids are payloads, never packed into
+        # merge keys, so the table has no bit-field cap
         self.values = _Interner()
         self.object_types = {"_root": "map"}  # objectId -> map | table
 
@@ -114,7 +116,7 @@ class BatchTranscoder:
             raise ValueError(
                 f"op counter {p.counter} exceeds the merge-key packing range"
             )
-        return (p.counter << 20) | self.actors.intern(p.actor_id)
+        return (p.counter << ACTOR_BITS) | self.actors.intern(p.actor_id)
 
     def slot_id(self, obj: str, key: str) -> int:
         return self.slots.intern((obj, key))
@@ -127,7 +129,7 @@ class BatchTranscoder:
             raise ValueError(
                 f"op counter {op_counter} exceeds the merge-key packing range"
             )
-        packed_id = (op_counter << 20) | self.actors.intern(actor)
+        packed_id = (op_counter << ACTOR_BITS) | self.actors.intern(actor)
         slot = self.slot_id(op.get("obj", "_root"), op["key"])
         pred = self.pack_opid_str(op["pred"][0]) if op.get("pred") else -1
         action = op["action"]

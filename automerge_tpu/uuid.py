@@ -1,5 +1,6 @@
 """UUID generation for actor IDs and table row IDs, with a swappable factory
 for deterministic tests (port of /root/reference/src/uuid.js)."""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
 import uuid as _stdlib_uuid

@@ -1,0 +1,7 @@
+"""AM101 clean fixture: a self-consistent canonical layout."""
+ACTOR_BITS = 20
+ACTOR_MASK = (1 << ACTOR_BITS) - 1
+_OP_BITS = 44
+_OP_MASK = (1 << _OP_BITS) - 1
+MAX_COUNTER = 1 << (_OP_BITS - ACTOR_BITS)
+MAX_ELEMS = 1 << (63 - _OP_BITS)

@@ -1,0 +1,3 @@
+"""AM101 suppressed fixture."""
+ACTOR_BITS = 20
+ACTOR_MASK = (1 << 19) - 1  # amlint: disable=AM101

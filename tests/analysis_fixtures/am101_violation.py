@@ -1,0 +1,3 @@
+"""AM101 violating fixture: mask does not match its bit width."""
+ACTOR_BITS = 20
+ACTOR_MASK = (1 << 19) - 1  # wrong: one bit short of ACTOR_BITS

@@ -1,0 +1,6 @@
+"""AM102 clean fixture: packing shifts use the named constant."""
+from automerge_tpu.tpu.engine import ACTOR_BITS
+
+
+def pack(ctr, actor_idx):
+    return (ctr << ACTOR_BITS) | actor_idx

@@ -1,0 +1,6 @@
+"""AM102 suppressed fixture."""
+from automerge_tpu.tpu.engine import ACTOR_BITS
+
+
+def pack(ctr, actor_idx):
+    return (ctr << 20) | actor_idx  # amlint: disable=AM102

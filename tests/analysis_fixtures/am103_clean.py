@@ -1,0 +1,4 @@
+"""AM103 clean fixture: the packing cap is explicit."""
+from automerge_tpu.tpu.transcode import _Interner
+
+actors = _Interner(max_size=1 << 20, name="actor")

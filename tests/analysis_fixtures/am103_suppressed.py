@@ -1,0 +1,5 @@
+"""AM103 suppressed fixture."""
+from automerge_tpu.tpu.transcode import _Interner
+
+# amlint: disable=AM103 — payload table, never packed into merge keys
+values = _Interner()

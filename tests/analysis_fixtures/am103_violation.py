@@ -1,0 +1,4 @@
+"""AM103 violating fixture: uncapped interner feeding packed keys."""
+from automerge_tpu.tpu.transcode import _Interner
+
+actors = _Interner()

@@ -1,0 +1,7 @@
+"""AM104 clean fixture: diagnostic names the range it guards."""
+MAX_COUNTER = 1 << 24
+
+
+def check(ctr):
+    if ctr >= MAX_COUNTER:
+        raise ValueError(f"op counter {ctr} exceeds the merge-key packing range")

@@ -1,0 +1,8 @@
+"""AM104 suppressed fixture."""
+MAX_COUNTER = 1 << 24
+
+
+def check(ctr):
+    if ctr >= MAX_COUNTER:
+        # amlint: disable=AM104 — intentionally legacy wording
+        raise ValueError(f"op counter {ctr} is out of range")

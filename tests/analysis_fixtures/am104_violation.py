@@ -1,0 +1,7 @@
+"""AM104 violating fixture: diagnostic points at the wrong range."""
+MAX_COUNTER = 1 << 24
+
+
+def check(ctr):
+    if ctr >= MAX_COUNTER:
+        raise ValueError(f"op counter {ctr} exceeds the rank kernel's packing range")

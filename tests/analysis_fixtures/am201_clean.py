@@ -1,0 +1,8 @@
+"""AM201 clean fixture: data-dependent select stays on device."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu(x):
+    return jnp.where(x > 0, x, jnp.zeros_like(x))

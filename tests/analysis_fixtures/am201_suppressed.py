@@ -1,0 +1,10 @@
+"""AM201 suppressed fixture."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu(x):
+    if x > 0:  # amlint: disable=AM201
+        return x
+    return jnp.zeros_like(x)

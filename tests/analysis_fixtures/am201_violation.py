@@ -1,0 +1,10 @@
+"""AM201 violating fixture: Python branch on a traced value."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu(x):
+    if x > 0:
+        return x
+    return jnp.zeros_like(x)

@@ -1,0 +1,8 @@
+"""AM202 clean fixture: device math stays in jax.numpy."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def total(x):
+    return jnp.sum(x)

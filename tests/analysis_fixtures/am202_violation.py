@@ -1,0 +1,8 @@
+"""AM202 violating fixture: host numpy applied to a tracer."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def total(x):
+    return np.asarray(x).sum()

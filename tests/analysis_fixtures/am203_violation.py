@@ -1,0 +1,6 @@
+"""AM203 violating fixture: dtype-less construction near device code."""
+import jax.numpy as jnp
+
+
+def make_table(n):
+    return jnp.zeros((n, n))

@@ -1,0 +1,9 @@
+"""AM204 clean fixture: traced code builds only local state."""
+import jax
+
+
+@jax.jit
+def record(x):
+    parts = []
+    parts.append(x)
+    return parts[0]

@@ -1,0 +1,10 @@
+"""AM204 suppressed fixture."""
+import jax
+
+_seen = []
+
+
+@jax.jit
+def record(x):
+    _seen.append(x)  # amlint: disable=AM204
+    return x

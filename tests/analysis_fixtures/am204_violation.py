@@ -1,0 +1,10 @@
+"""AM204 violating fixture: traced code mutates captured host state."""
+import jax
+
+_seen = []
+
+
+@jax.jit
+def record(x):
+    _seen.append(x)
+    return x

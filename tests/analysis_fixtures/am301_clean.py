@@ -1,0 +1,3 @@
+"""AM301 clean fixture: host-only module stays in the host layer."""
+# amlint: host-only
+from automerge_tpu.columnar import decode_change  # noqa: F401

@@ -1,0 +1,3 @@
+"""AM301 suppressed fixture."""
+# amlint: host-only
+from automerge_tpu.tpu.engine import ACTOR_BITS  # noqa: F401  # amlint: disable=AM301

@@ -1,0 +1,3 @@
+"""AM301 violating fixture: host-only module pulls device kernels."""
+# amlint: host-only
+from automerge_tpu.tpu.engine import ACTOR_BITS  # noqa: F401

@@ -1,0 +1,12 @@
+"""AM302 clean fixture: the transfer happens in a host phase."""
+import numpy as np
+
+from automerge_tpu.profiling import get_profile
+
+
+def dispatch(engine, batch):
+    prof = get_profile()
+    with prof.phase("device_dispatch"):
+        out = engine.apply_batch(batch)
+    with prof.phase("readback"):
+        return np.asarray(out)

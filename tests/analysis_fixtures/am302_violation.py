@@ -1,0 +1,11 @@
+"""AM302 violating fixture: device phase hides a host transfer."""
+import numpy as np
+
+from automerge_tpu.profiling import get_profile
+
+
+def dispatch(engine, batch):
+    prof = get_profile()
+    with prof.phase("device_dispatch"):
+        out = engine.apply_batch(batch)
+        return np.asarray(out)

@@ -134,3 +134,39 @@ def test_queued_list_change_released_by_map_only_delivery():
     p_ref = opset.apply_changes([buf1])
     assert p_farm == p_ref
     assert farm.get_patch(0) == opset.get_patch()
+
+
+def test_prevalidation_skipped_for_docs_with_no_delivery(monkeypatch):
+    """Docs that receive no changes in an apply_changes call must not pay
+    the O(queue ops) prevalidation re-scan: their queue was validated at its
+    original delivery and cannot become ready without new changes (ADVICE
+    round 5). Counts the prevalidation work via a spy."""
+    farm = TpuDocFarm(2)
+    missing_dep = "00" * 32
+    qbuf, _ = make_change("bbbbbbbb", 1, 10, [missing_dep],
+                          [{"action": "set", "obj": "_root", "key": "q",
+                            "value": 1, "pred": []}])
+    farm.apply_changes([[qbuf], []])
+    assert farm.get_patch(0)["pendingChanges"] == 1
+
+    prevalidated = []
+    orig = TpuDocFarm._prevalidate_limits
+
+    def spy(self, d, decoded):
+        prevalidated.append(d)
+        return orig(self, d, decoded)
+
+    monkeypatch.setattr(TpuDocFarm, "_prevalidate_limits", spy)
+    buf, _ = make_change("aaaaaaaa", 1, 1, [],
+                         [{"action": "set", "obj": "_root", "key": "a",
+                           "value": 1, "pred": []}])
+    # doc 0 gets nothing (its stuck queue must not be re-scanned);
+    # doc 1 receives a change and must still be prevalidated
+    farm.apply_changes([[], [buf]])
+    assert prevalidated == [1]
+    # a doc that receives changes keeps validating its queue too
+    buf2, _ = make_change("aaaaaaaa", 1, 1, [],
+                          [{"action": "set", "obj": "_root", "key": "b",
+                            "value": 2, "pred": []}])
+    farm.apply_changes([[buf2], []])
+    assert prevalidated == [1, 0]

@@ -1,0 +1,107 @@
+"""Tier-1 gate for the amlint static analysis suite (automerge_tpu.analysis).
+
+Two jobs:
+1. **Ratchet**: the full rule suite runs over the installed package and must
+   report zero unsuppressed findings — any commit that re-opens a packing
+   hole, leaks a Python branch into traced code, or crosses the host/device
+   module boundary fails tier-1.
+2. **Analyzer coverage**: every rule ID is exercised against a violating, a
+   clean, and a suppressed fixture under tests/analysis_fixtures/, and the
+   CLI contract (exit 0 clean / exit 1 findings) is pinned.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from automerge_tpu.analysis import RULES, default_target, run_analysis
+from automerge_tpu.analysis.__main__ import main as amlint_main
+
+PACKAGE = default_target()
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+
+# every implemented rule with fixtures (AM000 is the parse-failure escape
+# hatch and has no fixture triple)
+RULE_IDS = sorted(r for r in RULES if r != "AM000")
+
+
+def test_rule_catalog_covers_all_families():
+    families = {RULES[r][0] for r in RULE_IDS}
+    assert {"packing", "tracer", "boundary"} <= families
+    assert len(RULE_IDS) >= 6
+
+
+def test_repo_is_clean():
+    """The ratchet: the package must stay free of unsuppressed findings."""
+    findings = run_analysis([PACKAGE])
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_repo_suppressions_are_justified():
+    """Suppressed findings exist (the value-interner AM103 sites), proving
+    the suppression path is exercised in-tree, and each sits on a line whose
+    surrounding comment carries a justification."""
+    everything = run_analysis([PACKAGE], include_suppressed=True)
+    suppressed = [f for f in everything if f.suppressed]
+    assert suppressed, "expected in-tree justified suppressions"
+    assert all(f.rule_id == "AM103" for f in suppressed)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_violating_fixture_fires(rule_id):
+    path = FIXTURES / f"{rule_id.lower()}_violation.py"
+    findings = run_analysis([path])
+    assert any(f.rule_id == rule_id for f in findings), (
+        f"{path.name} should trigger {rule_id}; got "
+        f"{[f.format() for f in findings]}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_clean_fixture_is_clean(rule_id):
+    path = FIXTURES / f"{rule_id.lower()}_clean.py"
+    findings = run_analysis([path])
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_suppressed_fixture_is_silenced(rule_id):
+    path = FIXTURES / f"{rule_id.lower()}_suppressed.py"
+    assert run_analysis([path]) == []
+    everything = run_analysis([path], include_suppressed=True)
+    hits = [f for f in everything if f.rule_id == rule_id]
+    assert hits and all(f.suppressed for f in hits), (
+        f"{path.name} should carry a suppressed {rule_id} finding"
+    )
+
+
+def test_cli_exit_codes_in_process():
+    assert amlint_main(["-q", str(PACKAGE)]) == 0
+    for rule_id in RULE_IDS:
+        path = FIXTURES / f"{rule_id.lower()}_violation.py"
+        assert amlint_main(["-q", str(path)]) == 1, rule_id
+
+
+def test_cli_subprocess_contract():
+    """`python -m automerge_tpu.analysis` exits 0 on the repo and non-zero
+    on a violating fixture (the acceptance-criteria contract)."""
+    ok = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.analysis", str(PACKAGE)],
+        capture_output=True, text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.analysis",
+         str(FIXTURES / "am102_violation.py")],
+        capture_output=True, text=True,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "AM102" in bad.stdout
+
+
+def test_unparseable_file_reports_am000(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    findings = run_analysis([broken])
+    assert [f.rule_id for f in findings] == ["AM000"]
