@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native
+.PHONY: lint test native obs-report
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -14,3 +14,8 @@ test:
 
 native:
 	$(MAKE) -C native
+
+# span tree + metrics table for a small canned farm merge + sync
+# round-trip (automerge_tpu/obs; see README "Observability")
+obs-report:
+	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.obs --docs 4 --rounds 2 --ops 8

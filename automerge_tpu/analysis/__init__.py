@@ -26,7 +26,8 @@ Rule families (see core.RULES for the catalog):
   host calls on traced values (AM202), dtype-less array construction
   (AM203), captured-state mutation in traced code (AM204).
 - **AM3xx boundary**: host-only modules importing the device layer
-  (AM301), hidden host syncs inside device profiling phases (AM302).
+  (AM301), hidden host syncs inside device profiling phases (AM302),
+  metric/span recording inside jit/vmap/Pallas-reachable code (AM303).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -39,7 +40,7 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import boundary, packing, tracer
+from . import boundary, obsrules, packing, tracer
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -71,7 +72,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
         except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
-    for family in (packing, tracer, boundary):
+    for family in (packing, tracer, boundary, obsrules):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

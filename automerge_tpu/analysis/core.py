@@ -49,6 +49,9 @@ RULES: dict[str, tuple[str, str]] = {
                           "(automerge_tpu.tpu or jax)"),
     "AM302": ("boundary", "hidden host synchronisation inside a device "
                           "PhaseProfile phase"),
+    "AM303": ("boundary", "metric/span recording call inside jit/vmap/"
+                          "Pallas-reachable code (record on the host "
+                          "around the dispatch)"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -1,0 +1,115 @@
+"""AM303 — observability hygiene: no metric/span recording in traced code.
+
+The amtrace instruments (automerge_tpu/obs) are host-side Python: a
+counter ``inc()`` or a ``with trace.span(...)`` inside code that jax
+traces would execute ONCE at trace time and then be baked out of the
+compiled program — the metric silently stops counting (or worse, counts
+compile events as steady-state traffic). All recording must happen in the
+host wrappers around a dispatch, never inside it.
+
+The rule reuses the AM20x taint walker's trace-root discovery
+(tracer._ModuleChecker: jit-like decorators with static_argnums honoured,
+functions referenced as combinator arguments, nested defs handed to
+``jax.vmap``/``pl.pallas_call``/...) and extends it with a plain
+reachability pass: from every traced root, direct calls into module-level
+and nested functions are followed, so a helper called from a jitted entry
+point is checked too.
+
+Flagged inside jit/vmap/Pallas-reachable code:
+
+- any call whose root name was imported from ``automerge_tpu.obs`` (or the
+  ``profiling`` shim) — ``get_metrics()``, ``get_trace()``,
+  ``use_profile(...)``, ...;
+- any attribute call spelling a recording verb: ``.inc()``, ``.observe()``,
+  ``.span()``, ``.phase()``. (``Gauge.set`` is deliberately NOT matched —
+  ``.set(...)`` is too common a spelling on host containers; gauges must
+  therefore be set in host code by convention.)
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, dotted_name
+from .tracer import _ModuleChecker
+
+_RECORD_ATTRS = {"inc", "observe", "span", "phase"}
+_OBS_MODULE_HINTS = {"obs", "metrics", "spans", "profiling"}
+
+
+def _obs_aliases(tree: ast.Module) -> set[str]:
+    """Top-level names bound from the obs package (or the profiling shim):
+    ``from automerge_tpu.obs.metrics import get_metrics`` binds
+    ``get_metrics``; ``import automerge_tpu.obs as obs`` binds ``obs``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            parts = (node.module or "").split(".")
+            if any(p in _OBS_MODULE_HINTS for p in parts):
+                for alias in node.names:
+                    out.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = alias.name.split(".")
+                if any(p in _OBS_MODULE_HINTS for p in parts):
+                    out.add((alias.asname or alias.name).split(".")[0])
+    return out
+
+
+class _ObsChecker(_ModuleChecker):
+    """Reuses the AM20x walker's traced-root discovery; overrides the
+    per-function analysis with a recording-call scan plus direct-call
+    reachability (taint is irrelevant here — a recording call is wrong in
+    traced code whatever its arguments)."""
+
+    def __init__(self, ctx: FileContext):
+        super().__init__(ctx)
+        self.obs_aliases = _obs_aliases(ctx.tree)
+
+    def _analyze_function(self, fn, tainted, worklist) -> None:
+        nested = {
+            n.name: n
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            root = fname.split(".")[0] if fname else None
+            if root in self.obs_aliases:
+                self._emit(
+                    "AM303", node,
+                    f"`{fname}` (an obs/profiling binding) called inside "
+                    f"jit/vmap/Pallas-reachable code ({fn.name}): traced "
+                    "code runs once at trace time — record on the host "
+                    "around the dispatch",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RECORD_ATTRS
+            ):
+                self._emit(
+                    "AM303", node,
+                    f"`.{node.func.attr}()` metric/span recording inside "
+                    f"jit/vmap/Pallas-reachable code ({fn.name}): traced "
+                    "code runs once at trace time — record on the host "
+                    "around the dispatch",
+                )
+            # reachability: follow direct calls into sibling functions
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = nested.get(node.func.id) or self.module_funcs.get(
+                    node.func.id
+                )
+            if callee is not None and callee is not fn:
+                worklist.append((callee, frozenset()))
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        findings += _ObsChecker(ctx).run()
+    return findings

@@ -12,7 +12,13 @@ automerge_tpu.tpu) can serve the same frontend.
 from __future__ import annotations
 
 from .columnar import encode_change
+from .obs.metrics import get_metrics
+from .obs.spans import get_trace
 from .opset import OpSet
+
+_M_CHANGES_APPLIED = get_metrics().counter(
+    "backend.changes.applied", "changes applied through the backend facade"
+)
 
 
 class BackendHandle:
@@ -49,7 +55,9 @@ def free(backend: BackendHandle) -> None:
 
 def apply_changes(backend: BackendHandle, changes):
     state = _backend_state(backend)
-    patch = state.apply_changes(changes)
+    with get_trace().span("backend.apply_changes"):
+        patch = state.apply_changes(changes)
+    _M_CHANGES_APPLIED.inc(len(changes))
     backend.frozen = True
     return BackendHandle(state, state.heads), patch
 
@@ -84,7 +92,9 @@ def apply_local_change(backend: BackendHandle, change):
         change = dict(change, deps=sorted(deps.keys()))
 
     binary_change = encode_change(change)
-    patch = state.apply_changes([binary_change], is_local=True)
+    with get_trace().span("backend.apply_local_change"):
+        patch = state.apply_changes([binary_change], is_local=True)
+    _M_CHANGES_APPLIED.inc()
     backend.frozen = True
 
     # On the outgoing patch, omit the last local change hash
@@ -94,11 +104,13 @@ def apply_local_change(backend: BackendHandle, change):
 
 
 def save(backend: BackendHandle) -> bytes:
-    return _backend_state(backend).save()
+    with get_trace().span("backend.save"):
+        return _backend_state(backend).save()
 
 
 def load(data) -> BackendHandle:
-    state = OpSet(data)
+    with get_trace().span("backend.load"):
+        state = OpSet(data)
     return BackendHandle(state, state.heads)
 
 
@@ -111,7 +123,8 @@ def load_changes(backend: BackendHandle, changes) -> BackendHandle:
 
 
 def get_patch(backend: BackendHandle):
-    return _backend_state(backend).get_patch()
+    with get_trace().span("backend.get_patch"):
+        return _backend_state(backend).get_patch()
 
 
 def get_heads(backend: BackendHandle):

@@ -1,0 +1,49 @@
+"""amtrace — observability for the batched merge pipeline (SURVEY §5.1).
+
+The subsystem has two halves plus a CLI:
+
+- **Spans** (`obs.spans`): nested wall-clock span trees with per-span call
+  counts and fixed-bucket latency histograms (p50/p95/p99), ambient
+  propagation via ``contextvars`` (thread/task safe), JSON-lines export
+  and an indented tree-table renderer. ``automerge_tpu/profiling.py`` is a
+  thin compatibility shim over this layer — ``PhaseProfile`` /
+  ``get_profile`` / ``use_profile`` keep working unchanged.
+- **Metrics** (`obs.metrics`): counters/gauges/histograms in one
+  process-wide registry — farm batch occupancy and pad waste, engine jit
+  cache hits vs recompiles, sync message/byte/Bloom accounting. Disabled
+  by default; recording costs one attribute test until a workload enables
+  the registry.
+- **CLI**: ``python -m automerge_tpu.obs`` runs a canned farm merge + sync
+  round-trip (or reads a dumped JSONL trace) and prints the span tree and
+  metrics table. See the README "Observability" section for the metric
+  catalog.
+
+Everything here is host-side and stdlib-only: importing ``obs`` never
+initialises jax, and amlint rule AM303 keeps instrument calls out of
+jit/vmap/Pallas-reachable code.
+"""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
+from __future__ import annotations
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    enabled_metrics,
+    get_metrics,
+)
+from .spans import SpanNode, Trace, get_trace, use_trace
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanNode",
+    "Trace",
+    "enabled_metrics",
+    "get_metrics",
+    "get_trace",
+    "use_trace",
+]

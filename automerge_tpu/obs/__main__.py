@@ -1,0 +1,181 @@
+"""CLI entry point: ``python -m automerge_tpu.obs``.
+
+Runs a small canned workload — a farm merge (N docs, R change rounds
+through `TpuDocFarm.apply_changes`) followed by a batched sync round-trip
+between two farms (`SyncFarm` ping-pong until quiescent) — with spans and
+metrics enabled, then prints the span tree (p50/p95/p99 latencies) and the
+metrics table. Alternatively reads a previously dumped JSON-lines trace
+and renders it without running anything.
+
+    python -m automerge_tpu.obs                      # canned workload
+    python -m automerge_tpu.obs --docs 4 --rounds 2  # smaller/larger
+    python -m automerge_tpu.obs --dump trace.jsonl   # also write the trace
+    python -m automerge_tpu.obs --trace trace.jsonl  # render a dump, no run
+    python -m automerge_tpu.obs --json               # machine-readable
+
+The workload imports the device layer lazily, so ``--trace`` rendering
+works on hosts without jax initialisation. Exit code 0 on success.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+from .metrics import enabled_metrics, get_metrics
+from .spans import Trace, use_trace
+
+_SYNC_ROUND_LIMIT = 16
+
+
+def _change_stream(actor: str, rounds: int, ops_per_round: int, seed: int = 0):
+    """One actor's binary change stream: key-set ops through the real wire
+    format (the bench's end-to-end workload shape, bench.py)."""
+    from ..columnar import decode_change_columns, encode_change
+
+    rng = random.Random(seed)
+    buffers, last, max_op, deps = [], {}, 0, []
+    for r in range(rounds):
+        ops = []
+        start_op = max_op + 1
+        ctr = start_op
+        for _ in range(ops_per_round):
+            key = f"k{rng.randrange(16)}"
+            ops.append({"action": "set", "obj": "_root", "key": key,
+                        "datatype": "uint", "value": rng.randrange(10**6),
+                        "pred": [last[key]] if key in last else []})
+            last[key] = f"{ctr}@{actor}"
+            ctr += 1
+        max_op = ctr - 1
+        buf = encode_change({"actor": actor, "seq": r + 1, "startOp": start_op,
+                             "time": 0, "deps": deps, "ops": ops})
+        deps = [decode_change_columns(buf)["hash"]]
+        buffers.append(buf)
+    return buffers
+
+
+def _sync_round_trip(trace, farm_a, farm_b):
+    """Ping-pongs the batched sync protocol between two farms until both
+    sides go quiet (bounded rounds)."""
+    from ..tpu.sync_farm import SyncFarm
+
+    sync_a, sync_b = SyncFarm(farm_a), SyncFarm(farm_b)
+    n = farm_a.num_docs
+    states_a = [SyncFarm.init_state() for _ in range(n)]
+    states_b = [SyncFarm.init_state() for _ in range(n)]
+
+    def half_round(sender, states_s, receiver, states_r):
+        with trace.span("sync.generate"):
+            results = sender.generate_messages(
+                [(d, states_s[d]) for d in range(n)]
+            )
+        outgoing = []
+        for d, (state, msg) in enumerate(results):
+            states_s[d] = state
+            if msg is not None:
+                outgoing.append((d, msg))
+        if outgoing:
+            with trace.span("sync.receive"):
+                received = receiver.receive_messages(
+                    [(d, states_r[d], msg) for d, msg in outgoing]
+                )
+            for (d, _), (state, _patch) in zip(outgoing, received):
+                states_r[d] = state
+        return len(outgoing)
+
+    for _ in range(_SYNC_ROUND_LIMIT):
+        sent = half_round(sync_a, states_a, sync_b, states_b)
+        sent += half_round(sync_b, states_b, sync_a, states_a)
+        if sent == 0:
+            break
+
+
+def run_workload(num_docs: int, rounds: int, ops_per_round: int) -> Trace:
+    """Farm merge + sync round-trip under spans and metrics. Returns the
+    trace; metrics accumulate into the process-wide registry."""
+    from ..tpu.farm import TpuDocFarm
+
+    trace = Trace()
+    with use_trace(trace), enabled_metrics():
+        with trace.span("merge"):
+            farm_a = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
+            farm_b = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
+            streams_a = [
+                _change_stream("a" * 8 + f"{d:02x}" * 4, rounds,
+                               ops_per_round, seed=d)
+                for d in range(num_docs)
+            ]
+            streams_b = [
+                _change_stream("b" * 8 + f"{d:02x}" * 4, rounds,
+                               ops_per_round, seed=100 + d)
+                for d in range(num_docs)
+            ]
+            for r in range(rounds):
+                farm_a.apply_changes(
+                    [[streams_a[d][r]] for d in range(num_docs)]
+                )
+                farm_b.apply_changes(
+                    [[streams_b[d][r]] for d in range(num_docs)]
+                )
+        with trace.span("sync"):
+            _sync_round_trip(trace, farm_a, farm_b)
+    return trace
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m automerge_tpu.obs",
+        description="amtrace: span tree + metrics report for a canned farm "
+                    "merge + sync round-trip (or a dumped trace)",
+    )
+    parser.add_argument("--docs", type=int, default=4,
+                        help="documents per farm (default 4)")
+    parser.add_argument("--rounds", type=int, default=2,
+                        help="change rounds per document (default 2)")
+    parser.add_argument("--ops", type=int, default=8,
+                        help="ops per change (default 8)")
+    parser.add_argument("--trace", metavar="FILE",
+                        help="render a JSON-lines trace dump instead of "
+                             "running the workload")
+    parser.add_argument("--dump", metavar="FILE",
+                        help="also write the span tree as JSON lines")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON object instead of tables")
+    args = parser.parse_args(argv)
+
+    if args.trace:
+        with open(args.trace, "r", encoding="utf-8") as fh:
+            trace = Trace.from_jsonl(fh.read())
+        metrics = None
+    else:
+        # the canned workload is a host-shape measurement; keep it off a
+        # (possibly cold) accelerator unless the caller overrides
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        get_metrics().reset()
+        trace = run_workload(args.docs, args.rounds, args.ops)
+        metrics = get_metrics()
+
+    if args.dump:
+        with open(args.dump, "w", encoding="utf-8") as fh:
+            fh.write(trace.to_jsonl())
+
+    if args.json:
+        out = {"spans": [c.as_dict() for c in trace.root.children.values()]}
+        if metrics is not None:
+            out["metrics"] = metrics.as_dict()
+        print(json.dumps(out, sort_keys=True))
+        return 0
+
+    print("== spans ==")
+    print(trace.tree_table())
+    if metrics is not None:
+        print()
+        print("== metrics ==")
+        print(metrics.table(skip_zero=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""amtrace metrics: counters, gauges and histograms in one process-wide
+registry.
+
+Spans (obs/spans.py) answer "where did the time go"; metrics answer "what
+did the pipeline do": batch occupancy and pad waste in the farm, jit cache
+hits vs recompiles in the engine, message/byte/Bloom-probe counts in the
+sync layer. Instruments are fetched by name from the registry — two
+modules asking for ``counter("sync.messages.generated")`` share one
+instrument, so the sequential protocol (sync.py) and the batched farm
+(tpu/sync_farm.py) accumulate into the same totals.
+
+Recording is host-side only (amlint AM303 forbids instrument calls inside
+jit/vmap/Pallas-reachable code) and near-zero-cost when disabled: every
+``inc``/``set``/``observe`` starts with a single attribute test and does
+no further work (asserted by tests/test_obs.py). The process-wide registry
+starts *disabled*; bench.py and the obs CLI enable it around their
+workloads, so library users pay nothing unless they opt in.
+
+Histograms reuse the span layer's log2 bucket grid, which doubles as a
+general positive-float grid (e.g. occupancy ratios in (0, 1] land in the
+sub-1.0 buckets); quantiles report bucket upper bounds.
+"""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+from .spans import bucket_bounds, bucket_index
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("name", "help", "enabled", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.enabled = False
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if not self.enabled:
+            return
+        self.value += n
+
+    def snapshot(self):
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-observed value (e.g. the current pad-waste ratio)."""
+
+    __slots__ = ("name", "help", "enabled", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.enabled = False
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        if not self.enabled:
+            return
+        self.value = v
+
+    def snapshot(self):
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """Fixed-bucket distribution of positive floats (log2 grid shared with
+    the span layer)."""
+
+    __slots__ = ("name", "help", "enabled", "buckets", "count", "sum")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.enabled = False
+        self.buckets: dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        if not self.enabled:
+            return
+        b = bucket_index(v)
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+        self.count += 1
+        self.sum += v
+
+    def percentile(self, q: float) -> float | None:
+        if self.count == 0:
+            return None
+        threshold = q * self.count
+        cum = 0
+        for b in sorted(self.buckets):
+            cum += self.buckets[b]
+            if cum >= threshold:
+                return bucket_bounds(b)[1]
+        return bucket_bounds(max(self.buckets))[1]
+
+    def snapshot(self):
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "sum": self.sum,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+        }
+
+
+class MetricsRegistry:
+    """Name -> instrument table with a single enable switch.
+
+    ``enabled`` is mirrored onto every instrument at creation and on
+    enable()/disable(), so the per-record hot path tests one attribute on
+    the instrument itself and never chases the registry."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._instruments: dict[str, object] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def _get(self, cls, name: str, help: str):
+        inst = self._instruments.get(name)
+        if inst is None:
+            inst = cls(name, help)
+            inst.enabled = self.enabled
+            self._instruments[name] = inst
+        elif not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(inst).__name__}, not {cls.__name__}"
+            )
+        return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    # ------------------------------------------------------------------ #
+
+    def enable(self) -> None:
+        self.enabled = True
+        for inst in self._instruments.values():
+            inst.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+        for inst in self._instruments.values():
+            inst.enabled = False
+
+    def reset(self) -> None:
+        """Zeroes every instrument (registrations and help text survive)."""
+        for inst in self._instruments.values():
+            if isinstance(inst, Counter):
+                inst.value = 0
+            elif isinstance(inst, Gauge):
+                inst.value = 0.0
+            elif isinstance(inst, Histogram):
+                inst.buckets = {}
+                inst.count = 0
+                inst.sum = 0.0
+
+    # ------------------------------------------------------------------ #
+
+    def as_dict(self) -> dict:
+        return {
+            name: self._instruments[name].snapshot()
+            for name in sorted(self._instruments)
+        }
+
+    def table(self, skip_zero: bool = False) -> str:
+        """Human-readable metrics table, sorted by name."""
+        rows = []
+        for name in sorted(self._instruments):
+            snap = self._instruments[name].snapshot()
+            if snap["type"] == "histogram":
+                if skip_zero and snap["count"] == 0:
+                    continue
+                detail = (
+                    f"count={snap['count']} sum={snap['sum']:.4g} "
+                    f"p50={_fmt(snap['p50'])} p95={_fmt(snap['p95'])}"
+                )
+            else:
+                if skip_zero and not snap["value"]:
+                    continue
+                detail = _fmt(snap["value"])
+            rows.append((name, snap["type"], detail))
+        if not rows:
+            return "(no metrics recorded)"
+        width = max(len(name) for name, _, _ in rows)
+        return "\n".join(
+            f"{name.ljust(width)}  {type_:9s}  {detail}"
+            for name, type_, detail in rows
+        )
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+# ---------------------------------------------------------------------- #
+# the process-wide registry (disabled until a workload opts in)
+
+_GLOBAL = MetricsRegistry(enabled=False)
+
+
+def get_metrics() -> MetricsRegistry:
+    """The process-wide registry every instrumented module records into."""
+    return _GLOBAL
+
+
+@contextlib.contextmanager
+def enabled_metrics(
+    registry: MetricsRegistry | None = None,
+) -> Iterator[MetricsRegistry]:
+    """Enables a registry (the process-wide one by default) for the dynamic
+    extent, restoring the previous enabled state on exit."""
+    reg = registry if registry is not None else _GLOBAL
+    was_enabled = reg.enabled
+    reg.enable()
+    try:
+        yield reg
+    finally:
+        if not was_enabled:
+            reg.disable()

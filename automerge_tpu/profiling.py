@@ -1,96 +1,69 @@
-"""Per-phase timing for the batched backend (SURVEY.md §5.1).
+"""Per-phase timing shim over the amtrace span layer (SURVEY.md §5.1).
 
-The reference has no profiling layer (only nyc coverage); for the TPU
-build a phase breakdown is a first-class requirement: the applyChanges
-pipeline spans host decode, the causal gate, dense-row transcoding, the
-device merge program, and host patch assembly, and optimisation work needs
-to know where the time goes (the bench's phase table is built on this).
+Historically this module held the whole profiling layer: a flat per-phase
+wall-clock accumulator behind a *module-global* ambient slot. The real
+implementation now lives in ``automerge_tpu/obs/spans.py`` — nested span
+trees, latency histograms, and ambient propagation via ``contextvars`` (so
+concurrent farms in different threads/tasks no longer cross-pollute each
+other's profiles). This module keeps the original surface working:
 
-Usage:
     prof = PhaseProfile()
     with prof.phase("decode"):
         ...
     prof.as_dict()   # {"decode": {"total_s": ..., "calls": ...}, ...}
+    prof.table()     # flat breakdown, largest phase first
 
-Timers nest (a phase started inside another phase simply accumulates to
-its own bucket); `enabled=False` turns every context into a no-op with a
-single attribute test of overhead. A module-level `get_profile()` hands
-out the ambient profile installed by `use_profile()` so deep call sites
-(the farm, the engine) need no plumbing.
+``PhaseProfile`` IS a ``Trace`` — phases recorded through it are spans
+(nesting under the ambient span), and the flat ``totals``/``counts``/
+``as_dict``/``table`` views aggregate the tree by name exactly like the
+old accumulator. ``get_profile()``/``use_profile()`` are the span layer's
+ambient accessors, so a profile installed here is the same object the
+farm's ``obs`` spans record into; `enabled=False` keeps the historical
+one-attribute-test disabled cost.
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
-import contextlib
-import time
-from typing import Iterator
+from .obs.spans import Trace, get_trace, use_trace
 
 
-class PhaseProfile:
-    """Accumulates wall-clock totals and call counts per named phase."""
+class PhaseProfile(Trace):
+    """Flat-view compatibility wrapper over a span tree."""
 
-    __slots__ = ("totals", "counts", "enabled")
+    __slots__ = ()
 
-    def __init__(self, enabled: bool = True):
-        self.totals: dict[str, float] = {}
-        self.counts: dict[str, int] = {}
-        self.enabled = enabled
+    @property
+    def totals(self) -> dict[str, float]:
+        return {name: t for name, (t, _) in self.totals_by_name().items()}
 
-    @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            elapsed = time.perf_counter() - start
-            self.totals[name] = self.totals.get(name, 0.0) + elapsed
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
+    @property
+    def counts(self) -> dict[str, int]:
+        return {name: c for name, (_, c) in self.totals_by_name().items()}
 
     def as_dict(self) -> dict:
         return {
-            name: {"total_s": self.totals[name], "calls": self.counts[name]}
-            for name in sorted(self.totals)
+            name: {"total_s": t, "calls": c}
+            for name, (t, c) in sorted(self.totals_by_name().items())
         }
 
     def table(self) -> str:
         """Human-readable breakdown, largest phase first."""
-        if not self.totals:
+        flat = self.totals_by_name()
+        if not flat:
             return "(no phases recorded)"
-        width = max(len(n) for n in self.totals)
-        total = sum(self.totals.values())
+        width = max(len(n) for n in flat)
+        total = sum(t for t, _ in flat.values())
         lines = []
-        for name in sorted(self.totals, key=self.totals.get, reverse=True):
-            t = self.totals[name]
+        for name in sorted(flat, key=lambda n: flat[n][0], reverse=True):
+            t, calls = flat[name]
+            pct = 100 * t / total if total else 0.0
             lines.append(
                 f"{name.ljust(width)}  {t * 1e3:10.2f} ms  "
-                f"{100 * t / total:5.1f}%  x{self.counts[name]}"
+                f"{pct:5.1f}%  x{calls}"
             )
         return "\n".join(lines)
 
 
-_NULL = PhaseProfile(enabled=False)
-_current = _NULL
-
-
-def get_profile() -> PhaseProfile:
-    """The ambient profile (a disabled no-op unless one is installed)."""
-    return _current
-
-
-@contextlib.contextmanager
-def use_profile(profile: PhaseProfile) -> Iterator[PhaseProfile]:
-    """Installs `profile` as the ambient profile for the dynamic extent."""
-    global _current
-    prev = _current
-    _current = profile
-    try:
-        yield profile
-    finally:
-        _current = prev
+# the ambient accessors ARE the span layer's: one mechanism, two spellings
+get_profile = get_trace
+use_profile = use_trace

@@ -16,6 +16,7 @@ from math import ceil
 from . import backend as Backend
 from .codecs import Decoder, Encoder, bytes_to_hex, hex_to_bytes
 from .columnar import decode_change_meta
+from .obs.metrics import get_metrics
 
 HASH_SIZE = 32
 MESSAGE_TYPE_SYNC = 0x42
@@ -25,6 +26,44 @@ PEER_STATE_TYPE = 0x43
 # they can be changed without breaking protocol compatibility (sync.js:29-31)
 BITS_PER_ENTRY = 10
 NUM_PROBES = 7
+
+# sync-protocol metrics (obs/metrics.py; disabled unless a workload opts
+# in). The batched farm driver (tpu/sync_farm.py) records into the SAME
+# instruments — fetched by name from the process-wide registry — so
+# sequential and batched sync accumulate one set of totals.
+_METRICS = get_metrics()
+_M_MSGS_GEN = _METRICS.counter(
+    "sync.messages.generated", "sync messages encoded for peers"
+)
+_M_MSGS_RECV = _METRICS.counter(
+    "sync.messages.received", "sync messages decoded from peers"
+)
+_M_BYTES_SENT = _METRICS.counter(
+    "sync.bytes.sent", "wire bytes of generated sync messages"
+)
+_M_BYTES_RECV = _METRICS.counter(
+    "sync.bytes.received", "wire bytes of received sync messages"
+)
+_M_CHANGES_SENT = _METRICS.counter(
+    "sync.changes.sent", "changes attached to generated sync messages"
+)
+_M_CHANGES_RECV = _METRICS.counter(
+    "sync.changes.received", "changes carried by received sync messages"
+)
+_M_NEED_REQUESTED = _METRICS.counter(
+    "sync.changes.need_requested", "hashes peers explicitly requested via need"
+)
+_M_BLOOM_PROBES = _METRICS.counter(
+    "sync.bloom.probes", "Bloom filter bit probes evaluated (host + device)"
+)
+_M_BLOOM_HITS = _METRICS.counter(
+    "sync.bloom.hits", "Bloom membership tests that returned positive"
+)
+_M_BLOOM_FP = _METRICS.counter(
+    "sync.bloom.false_positives",
+    "Bloom positives contradicted by an explicit peer need (changes the "
+    "filter wrongly claimed the peer already had)",
+)
 
 
 class BloomFilter:
@@ -92,9 +131,13 @@ class BloomFilter:
     def contains_hash(self, hash_):
         if self.num_entries == 0:
             return False
-        for probe in self.get_probes(hash_):
+        probes = self.get_probes(hash_)
+        for i, probe in enumerate(probes):
             if not (self.bits[probe >> 3] & (1 << (probe & 7))):
+                _M_BLOOM_PROBES.inc(i + 1)
                 return False
+        _M_BLOOM_PROBES.inc(len(probes))
+        _M_BLOOM_HITS.inc()
         return True
 
 
@@ -179,6 +222,7 @@ def make_bloom_filter(backend, last_sync):
 def get_changes_to_send(backend, have, need):
     """Changes to send given the peer's have/need (sync.js:246): Bloom-negative
     changes, their dependents closure, plus explicitly needed hashes."""
+    _M_NEED_REQUESTED.inc(len(need))
     if not have:
         changes = [Backend.get_change_by_hash(backend, h) for h in need]
         return [c for c in changes if c is not None]
@@ -216,6 +260,10 @@ def get_changes_to_send(backend, have, need):
 
     changes_to_send = []
     for hash_ in need:
+        # a needed hash we hold but withheld as Bloom-positive is a
+        # *detected* false positive: the filter claimed the peer had it
+        if hash_ in change_hashes and hash_ not in hashes_to_send:
+            _M_BLOOM_FP.inc()
         hashes_to_send[hash_] = True
         if hash_ not in change_hashes:
             change = Backend.get_change_by_hash(backend, hash_)
@@ -268,7 +316,10 @@ def generate_sync_message(backend, sync_state):
                 "heads": our_heads, "need": [],
                 "have": [{"lastSync": [], "bloom": b""}], "changes": [],
             }
-            return sync_state, encode_sync_message(reset_msg)
+            encoded = encode_sync_message(reset_msg)
+            _M_MSGS_GEN.inc()
+            _M_BYTES_SENT.inc(len(encoded))
+            return sync_state, encoded
 
     changes_to_send = (
         get_changes_to_send(backend, their_have, their_need)
@@ -292,7 +343,11 @@ def generate_sync_message(backend, sync_state):
             sent_hashes[decode_change_meta(change, True)["hash"]] = True
 
     sync_state = dict(sync_state, lastSentHeads=our_heads, sentHashes=sent_hashes)
-    return sync_state, encode_sync_message(sync_message)
+    encoded = encode_sync_message(sync_message)
+    _M_MSGS_GEN.inc()
+    _M_BYTES_SENT.inc(len(encoded))
+    _M_CHANGES_SENT.inc(len(changes_to_send))
+    return sync_state, encoded
 
 
 def _advance_heads(my_old_heads, my_new_heads, our_old_shared_heads):
@@ -314,6 +369,9 @@ def receive_sync_message(backend, old_sync_state, binary_message):
     sent_hashes = old_sync_state["sentHashes"]
     patch = None
     message = decode_sync_message(binary_message)
+    _M_MSGS_RECV.inc()
+    _M_BYTES_RECV.inc(len(binary_message))
+    _M_CHANGES_RECV.inc(len(message["changes"]))
     before_heads = Backend.get_heads(backend)
 
     if message["changes"]:

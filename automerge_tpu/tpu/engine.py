@@ -31,6 +31,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.metrics import get_metrics
+
 PAD_KEY = jnp.iinfo(jnp.int32).max
 ACTOR_BITS = 20
 ACTOR_MASK = (1 << ACTOR_BITS) - 1
@@ -39,6 +41,48 @@ _NEG_INF = jnp.int64(-(2**62))
 ACTION_SET = 0
 ACTION_INC = 1
 ACTION_DEL = 2
+
+# engine metrics (process-wide registry, disabled unless a workload opts
+# in — obs/metrics.py). Dispatch accounting lives in the HOST wrappers
+# below, never inside traced code (amlint AM303).
+_METRICS = get_metrics()
+_M_DISPATCHES = _METRICS.counter(
+    "engine.device.dispatches",
+    "batched device programs dispatched (merge + visibility)",
+)
+_M_JIT_HITS = _METRICS.counter(
+    "engine.jit.cache_hits",
+    "dispatches served by an already-compiled program",
+)
+_M_JIT_RECOMPILES = _METRICS.counter(
+    "engine.jit.recompiles",
+    "dispatches that triggered an XLA compile (shape-bucket misses)",
+)
+_M_STATE_GROWS = _METRICS.counter(
+    "engine.state.grows",
+    "capacity doublings of the dense device state",
+)
+
+
+def _dispatch(jitted, *args):
+    """Runs a jitted entry point, classifying the call as a jit cache hit
+    or a recompile by the growth of the function's compile cache across the
+    call. This is the single device-dispatch funnel for the engine, so the
+    recompile-storm and dispatch-count metrics cover every merge and
+    visibility program; with metrics disabled it degrades to a plain call."""
+    if not _METRICS.enabled:
+        return jitted(*args)
+    size_fn = getattr(jitted, "_cache_size", None)
+    before = size_fn() if size_fn is not None else -1
+    out = jitted(*args)
+    _M_DISPATCHES.inc()
+    if size_fn is not None:
+        grew = size_fn() - before
+        if grew > 0:
+            _M_JIT_RECOMPILES.inc(grew)
+        else:
+            _M_JIT_HITS.inc()
+    return out
 
 
 def pack_opid(counter, actor):
@@ -285,7 +329,7 @@ def batched_visible_state(state: BatchedDocState, actor_rank=None):
         cmp = state.op
     else:
         cmp = remap_opid_actors(state.op, actor_rank)
-    return _batched_visible_state_cmp(state, cmp)
+    return _dispatch(_batched_visible_state_cmp, state, cmp)
 
 
 class BatchedMapEngine:
@@ -306,7 +350,8 @@ class BatchedMapEngine:
         while needed > self.capacity:
             self.capacity *= 2
             self.state = _grow_state(self.state, self.capacity)
-        self.state = batched_apply_ops(self.state, changes)
+            _M_STATE_GROWS.inc()
+        self.state = _dispatch(batched_apply_ops, self.state, changes)
         return self.state
 
     def visible_state(self, actor_rank=None):

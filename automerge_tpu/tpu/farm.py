@@ -47,6 +47,7 @@ import numpy as np
 
 from ..columnar import decode_change, decode_change_meta
 from ..common import utf16_key
+from ..obs.metrics import get_metrics
 from ..opset import OpSet
 from .engine import (
     ACTION_DEL,
@@ -75,6 +76,36 @@ class ChildObj(NamedTuple):
 
 
 _ROOT_META = {"parentObj": None, "parentKey": None, "type": "map"}
+
+# farm metrics (process-wide registry, disabled unless a workload opts in —
+# obs/metrics.py). All recording is host-side, outside the device phases.
+_METRICS = get_metrics()
+_M_ROWS = _METRICS.counter(
+    "farm.rows.transcoded", "dense op rows produced by gate+transcode"
+)
+_M_PAD_ROWS = _METRICS.counter(
+    "farm.rows.padding", "wasted (padded) cells in packed device batches"
+)
+_M_PAD_RATIO = _METRICS.gauge(
+    "farm.pad_waste_ratio", "padding fraction of the last packed batch"
+)
+_M_OCCUPANCY = _METRICS.histogram(
+    "farm.batch.occupancy", "rows / cells fill ratio per packed batch"
+)
+_M_ABORTS = _METRICS.counter(
+    "farm.prevalidation.aborts",
+    "apply_changes calls rejected batch-wide by the packing-limit pre-pass",
+)
+_M_APPLIED = _METRICS.counter(
+    "farm.changes.applied", "changes committed by the causal gate"
+)
+_M_DEFERRALS = _METRICS.counter(
+    "farm.gate.deferrals",
+    "delivered changes left causally pending (queued) by the gate",
+)
+_M_WALKS = _METRICS.counter(
+    "farm.exact.walks", "documents served by the embedded reference walk"
+)
 
 _MAKE_TYPES = {
     "makeMap": "map",
@@ -553,9 +584,13 @@ class TpuDocFarm:
         # doc commits, so re-scanning the queue would be O(queue ops) of
         # redundant work per call (ADVICE round 5). Docs that do receive
         # changes still re-scan their queue inside _prevalidate_limits.
-        for d, decoded in enumerate(per_doc_decoded):
-            if decoded:
-                self._prevalidate_limits(d, decoded)
+        try:
+            for d, decoded in enumerate(per_doc_decoded):
+                if decoded:
+                    self._prevalidate_limits(d, decoded)
+        except ValueError:
+            _M_ABORTS.inc()
+            raise
 
         # list/text-targeting docs route through the reference walk, whose
         # patch is authoritative for them (byte-exact edit streams; see
@@ -617,9 +652,29 @@ class TpuDocFarm:
                         break
                 self.queue[d] = pending
 
+        if _METRICS.enabled:
+            _M_WALKS.inc(len(exact_patches))
+            _M_APPLIED.inc(sum(len(c) for c in applied_changes))
+            delivered = {
+                c["hash"] for decoded in per_doc_decoded for c in decoded
+            }
+            _M_DEFERRALS.inc(sum(
+                1
+                for d in range(self.num_docs)
+                for c in self.queue[d]
+                if c["hash"] in delivered
+            ))
+
         # one device merge for the whole batch
         width = max((len(r) for r in per_doc_rows), default=0)
         if width > 0:
+            if _METRICS.enabled:
+                rows = sum(len(r) for r in per_doc_rows)
+                cells = self.num_docs * width
+                _M_ROWS.inc(rows)
+                _M_PAD_ROWS.inc(cells - rows)
+                _M_PAD_RATIO.set(1.0 - rows / cells)
+                _M_OCCUPANCY.observe(rows / cells)
             with prof.phase("pack"):
                 keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
                 ops = np.zeros((self.num_docs, width), np.int64)

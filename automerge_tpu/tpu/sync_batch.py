@@ -21,9 +21,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..codecs import hex_to_bytes
+from ..obs.metrics import get_metrics
 from ..sync import BITS_PER_ENTRY, NUM_PROBES
 
 WORD_BITS = 32
+
+# Host-side accounting only: the jitted kernels below must stay free of
+# instrument calls (amlint AM303); serialisation is the one funnel every
+# device-built filter passes through.
+_M_FILTERS_BUILT = get_metrics().counter(
+    "sync.filters.built", "Bloom filters built on device and serialised"
+)
+_M_FILTER_BYTES = get_metrics().counter(
+    "sync.filters.bytes", "wire bytes of serialised device-built filters"
+)
 
 
 def hash_to_xyz(hash_hex: str) -> tuple[int, int, int]:
@@ -140,6 +151,9 @@ def filters_to_bytes(words, modulo, counts):
         num_bytes = int(modulo[b]) // 8
         encoder.append_raw_bytes(words[b].tobytes()[:num_bytes])
         out.append(encoder.buffer)
+    if _M_FILTERS_BUILT.enabled:
+        _M_FILTERS_BUILT.inc(sum(1 for blob in out if blob))
+        _M_FILTER_BYTES.inc(sum(len(blob) for blob in out))
     return out
 
 

@@ -29,8 +29,10 @@ from math import ceil
 import numpy as np
 
 from ..columnar import decode_change_meta
+from ..obs.metrics import get_metrics
 from ..sync import (
     BITS_PER_ENTRY,
+    NUM_PROBES,
     decode_sync_message,
     encode_sync_message,
     init_sync_state,
@@ -44,6 +46,22 @@ from .sync_batch import (
     pack_hashes,
     query_filters,
 )
+
+# Batched sync records into the SAME named instruments as the sequential
+# protocol (sync.py): one set of totals whichever driver runs. The device
+# query kernel evaluates all NUM_PROBES bits per candidate (no early
+# exit), so its probe count is candidates x NUM_PROBES.
+_METRICS = get_metrics()
+_M_MSGS_GEN = _METRICS.counter("sync.messages.generated")
+_M_MSGS_RECV = _METRICS.counter("sync.messages.received")
+_M_BYTES_SENT = _METRICS.counter("sync.bytes.sent")
+_M_BYTES_RECV = _METRICS.counter("sync.bytes.received")
+_M_CHANGES_SENT = _METRICS.counter("sync.changes.sent")
+_M_CHANGES_RECV = _METRICS.counter("sync.changes.received")
+_M_NEED_REQUESTED = _METRICS.counter("sync.changes.need_requested")
+_M_BLOOM_PROBES = _METRICS.counter("sync.bloom.probes")
+_M_BLOOM_HITS = _METRICS.counter("sync.bloom.hits")
+_M_BLOOM_FP = _METRICS.counter("sync.bloom.false_positives")
 
 
 def filters_from_bytes(blobs):
@@ -136,13 +154,20 @@ class SyncFarm:
                 for c, h in enumerate(hashes):
                     q[b, c] = hash_to_xyz(h)
             contained = np.asarray(query_filters(words, modulo, counts, q))
+            total_hits = 0
             for b, i in enumerate(query_idx):
                 hits = {
                     h
                     for c, h in enumerate(cand_lists[b])
                     if contained[b, c]
                 }
+                total_hits += len(hits)
                 plans[i]["bloom_positive"] = hits
+            if _METRICS.enabled:
+                _M_BLOOM_PROBES.inc(
+                    NUM_PROBES * sum(len(c) for c in cand_lists)
+                )
+                _M_BLOOM_HITS.inc(total_hits)
 
         results = []
         for (d, state), plan in zip(channels, plans):
@@ -222,7 +247,10 @@ class SyncFarm:
                 "heads": plan["our_heads"], "need": [],
                 "have": [{"lastSync": [], "bloom": b""}], "changes": [],
             }
-            return state, encode_sync_message(msg)
+            encoded = encode_sync_message(msg)
+            _M_MSGS_GEN.inc()
+            _M_BYTES_SENT.inc(len(encoded))
+            return state, encoded
 
         their_have = state["theirHave"]
         their_need = state["theirNeed"]
@@ -268,7 +296,11 @@ class SyncFarm:
             for change in changes_to_send:
                 sent_hashes[decode_change_meta(change, True)["hash"]] = True
         new_state = dict(state, lastSentHeads=our_heads, sentHashes=sent_hashes)
-        return new_state, encode_sync_message(msg)
+        encoded = encode_sync_message(msg)
+        _M_MSGS_GEN.inc()
+        _M_BYTES_SENT.inc(len(encoded))
+        _M_CHANGES_SENT.inc(len(changes_to_send))
+        return new_state, encoded
 
     def _changes_to_send(self, d, plan, their_have, their_need):
         """Bloom-negative changes + dependents closure + explicit needs
@@ -302,7 +334,12 @@ class SyncFarm:
                     stack.append(dep)
 
         out = []
+        _M_NEED_REQUESTED.inc(len(their_need))
         for h in their_need:
+            # a needed hash we hold but withheld as Bloom-positive is a
+            # detected false positive (same accounting as sync.py)
+            if h in change_hashes and h not in to_send:
+                _M_BLOOM_FP.inc()
             to_send.add(h)
             if h not in change_hashes:
                 change = self.farm.get_change_by_hash(d, h)
@@ -324,6 +361,10 @@ class SyncFarm:
         [(new_state, patch|None)] in channel order."""
         farm = self.farm
         decoded = [decode_sync_message(m) for _, _, m in channels_msgs]
+        if _METRICS.enabled:
+            _M_MSGS_RECV.inc(len(channels_msgs))
+            _M_BYTES_RECV.inc(sum(len(m) for _, _, m in channels_msgs))
+            _M_CHANGES_RECV.inc(sum(len(m["changes"]) for m in decoded))
         docs = [d for d, _, _ in channels_msgs]
         if len(set(docs)) != len(docs):
             return [

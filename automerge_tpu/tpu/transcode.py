@@ -30,8 +30,13 @@ from .engine import (
     changes_from_numpy,
 )
 from ..common import parse_op_id
+from ..obs.metrics import get_metrics
 
 _COUNTER_TAG = object()
+
+_M_ROWS = get_metrics().counter(
+    "transcode.rows", "ops packed into dense rows by BatchTranscoder"
+)
 
 # Slot ids ride the high bits of the engine's packed int64 merge key
 # (slot << 44 | opid): 63 value bits - 44 opid bits = 19 bits of slot before
@@ -152,6 +157,8 @@ class BatchTranscoder:
         """`per_doc_ops` is a list (one entry per document) of lists of
         (op_dict, op_counter, actor) tuples. Returns a padded ChangeOpsBatch."""
         num_docs = len(per_doc_ops)
+        if _M_ROWS.enabled:
+            _M_ROWS.inc(sum(len(ops) for ops in per_doc_ops))
         m = width or max((len(ops) for ops in per_doc_ops), default=1) or 1
         keys = np.full((num_docs, m), PAD_KEY, np.int32)
         ops = np.zeros((num_docs, m), np.int64)

@@ -122,6 +122,7 @@ def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
     in, reference-format patches out, with a per-phase breakdown
     (decode / walk / gate+transcode / pack / device / visibility /
     patch_assembly)."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
     from automerge_tpu.profiling import PhaseProfile, use_profile
     from automerge_tpu.tpu.farm import TpuDocFarm
 
@@ -132,20 +133,41 @@ def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
     warm = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
     warm.apply_changes([[buffers[0]]] * num_docs)
 
+    # metrics cover only the timed section: recompiles here are steady-state
+    # compile storms (shape-bucket misses), not the excluded warm-up
+    metrics = get_metrics()
+    metrics.reset()
     prof = PhaseProfile()
     start = time.perf_counter()
-    with use_profile(prof):
+    with use_profile(prof), enabled_metrics():
         for buf in buffers:
             farm.apply_changes([[buf]] * num_docs)
     elapsed = time.perf_counter() - start
 
     total_ops = num_docs * rounds * ops_per_round
+    snap = metrics.as_dict()
+
+    def _value(name):
+        return snap.get(name, {}).get("value", 0)
+
     return {
         "ops_per_sec": total_ops / elapsed,
         "elapsed_s": elapsed,
         "phases": {
             name: round(entry["total_s"], 4)
             for name, entry in prof.as_dict().items()
+        },
+        "metrics": {
+            "device_dispatches": _value("engine.device.dispatches"),
+            "jit_cache_hits": _value("engine.jit.cache_hits"),
+            "jit_recompiles": _value("engine.jit.recompiles"),
+            "rows_transcoded": _value("farm.rows.transcoded"),
+            "rows_padding": _value("farm.rows.padding"),
+            "pad_waste_ratio": round(_value("farm.pad_waste_ratio"), 4),
+            "changes_applied": _value("farm.changes.applied"),
+            "gate_deferrals": _value("farm.gate.deferrals"),
+            "sync_bytes_sent": _value("sync.bytes.sent"),
+            "sync_bytes_received": _value("sync.bytes.received"),
         },
     }
 
@@ -301,6 +323,7 @@ def main():
             "ops_per_sec": round(e2e["ops_per_sec"]),
             "vs_baseline": round(e2e["ops_per_sec"] / py_ops_per_sec, 2),
             "phases_s": e2e["phases"],
+            "metrics": e2e.get("metrics", {}),
         }
     if errors:
         out["retried"] = len(errors)

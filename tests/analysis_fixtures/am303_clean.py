@@ -1,0 +1,15 @@
+"""AM303 clean fixture: recording happens on the host, around the dispatch."""
+import jax
+
+from automerge_tpu.obs.metrics import get_metrics
+
+
+@jax.jit
+def merge(x):
+    return x * 2
+
+
+def dispatch(x):
+    out = merge(x)
+    get_metrics().counter("merge.calls").inc()
+    return out

@@ -1,0 +1,10 @@
+"""AM303 violating fixture: metric recording inside traced code."""
+import jax
+
+from automerge_tpu.obs.metrics import get_metrics
+
+
+@jax.jit
+def merge(x):
+    get_metrics().counter("merge.calls").inc()
+    return x * 2

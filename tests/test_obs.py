@@ -1,0 +1,477 @@
+"""amtrace observability suite (automerge_tpu/obs + the profiling shim).
+
+Covers the acceptance contract of the obs subsystem:
+- span trees: nesting, flat aggregation by name, the tree/table renderers
+  (including the previously untested PhaseProfile.table()), histogram
+  bucket boundaries and p50/p95/p99 extraction, JSON-lines round-trip;
+- ambient propagation: contextvars isolation across two interleaved
+  contexts (the race the old module-global ambient slot had);
+- disabled-mode cost: a disabled span/instrument performs one attribute
+  test and touches neither the clock nor the ambient state;
+- metrics registry: get-or-create by name (shared across modules), type
+  conflicts, enable/disable/reset, rendering;
+- integration: farm + engine + sync instrumentation counts real work, and
+  the ``python -m automerge_tpu.obs`` CLI prints a span tree with
+  percentiles plus a metrics table for a farm merge + sync round-trip.
+"""
+import contextvars
+import json
+
+import pytest
+
+from automerge_tpu.obs import metrics as metrics_mod
+from automerge_tpu.obs import spans as spans_mod
+from automerge_tpu.obs.metrics import (
+    MetricsRegistry,
+    enabled_metrics,
+    get_metrics,
+)
+from automerge_tpu.obs.spans import (
+    BUCKET_FLOOR_S,
+    NUM_BUCKETS,
+    SpanNode,
+    Trace,
+    bucket_bounds,
+    bucket_index,
+)
+from automerge_tpu.profiling import PhaseProfile, get_profile, use_profile
+
+
+# ---------------------------------------------------------------------- #
+# histogram buckets
+
+def test_bucket_index_boundaries():
+    # below the floor and zero clamp to the first bucket
+    assert bucket_index(0.0) == 0
+    assert bucket_index(BUCKET_FLOOR_S / 2) == 0
+    assert bucket_index(BUCKET_FLOOR_S) == 0
+    # an exact power-of-two boundary starts the NEXT bucket
+    assert bucket_index(2 * BUCKET_FLOOR_S) == 1
+    assert bucket_index(4 * BUCKET_FLOOR_S) == 2
+    assert bucket_index(3.999 * BUCKET_FLOOR_S) == 1
+    # far overflow clamps to the last bucket
+    assert bucket_index(1e9) == NUM_BUCKETS - 1
+
+
+def test_bucket_bounds_are_log2_spaced():
+    for i in range(NUM_BUCKETS):
+        lo, hi = bucket_bounds(i)
+        assert hi == pytest.approx(2 * lo)
+        assert lo == pytest.approx(BUCKET_FLOOR_S * (1 << i))
+    # record() and bounds agree: a value lands inside its bucket
+    node = SpanNode("x")
+    node.record(5 * BUCKET_FLOOR_S)
+    (b,) = node.buckets
+    lo, hi = bucket_bounds(b)
+    assert lo <= 5 * BUCKET_FLOOR_S < hi
+
+
+def test_percentiles_read_bucket_upper_bounds():
+    node = SpanNode("x")
+    node.buckets = {0: 50, 5: 45, 10: 5}
+    node.calls = 100
+    assert node.percentile(0.50) == pytest.approx(bucket_bounds(0)[1])
+    assert node.percentile(0.95) == pytest.approx(bucket_bounds(5)[1])
+    assert node.percentile(0.99) == pytest.approx(bucket_bounds(10)[1])
+    assert SpanNode("empty").percentile(0.5) is None
+
+
+# ---------------------------------------------------------------------- #
+# PhaseProfile flat views (previously untested)
+
+def test_phase_profile_table_empty():
+    assert PhaseProfile().table() == "(no phases recorded)"
+
+
+def test_phase_profile_table_single_phase():
+    prof = PhaseProfile()
+    with prof.phase("only"):
+        pass
+    table = prof.table()
+    assert "only" in table
+    assert "100.0%" in table
+    assert "x1" in table
+
+
+def test_phase_profile_nested_spans_aggregate_by_name():
+    prof = PhaseProfile()
+    with prof.phase("a"):
+        with prof.phase("b"):
+            pass
+    with prof.phase("b"):
+        pass
+    assert prof.counts == {"a": 1, "b": 2}
+    d = prof.as_dict()
+    assert sorted(d) == ["a", "b"]
+    assert d["b"]["calls"] == 2
+    assert d["b"]["total_s"] >= 0.0
+    # the flat table carries both names whatever the nesting
+    table = prof.table()
+    assert "x2" in table and "a" in table and "b" in table
+
+
+def test_phase_profile_is_a_trace_with_a_tree():
+    prof = PhaseProfile()
+    with prof.phase("outer"):
+        with prof.phase("inner"):
+            pass
+    assert list(prof.root.children) == ["outer"]
+    assert list(prof.root.children["outer"].children) == ["inner"]
+
+
+# ---------------------------------------------------------------------- #
+# span tree renderer + JSONL export
+
+def _sample_trace():
+    trace = Trace()
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+        with trace.span("inner"):
+            pass
+    with trace.span("solo"):
+        pass
+    return trace
+
+
+def test_tree_table_renders_nesting_and_percentiles():
+    table = _sample_trace().tree_table()
+    lines = table.splitlines()
+    assert "p50" in lines[0] and "p95" in lines[0] and "p99" in lines[0]
+    assert any(line.startswith("outer") for line in lines)
+    assert any(line.startswith("  inner") for line in lines)
+    assert Trace().tree_table() == "(no spans recorded)"
+
+
+def test_jsonl_round_trip_preserves_the_tree():
+    trace = _sample_trace()
+    text = trace.to_jsonl()
+    # one JSON object per node, each with a path from the root
+    entries = [json.loads(line) for line in text.splitlines()]
+    assert {tuple(e["path"]) for e in entries} == {
+        ("outer",), ("outer", "inner"), ("solo",)
+    }
+    rebuilt = Trace.from_jsonl(text)
+    inner = rebuilt.root.children["outer"].children["inner"]
+    assert inner.calls == 2
+    assert inner.total_s == pytest.approx(
+        trace.root.children["outer"].children["inner"].total_s
+    )
+    assert inner.buckets == trace.root.children["outer"].children["inner"].buckets
+    # concatenated dumps merge (counts accumulate)
+    doubled = Trace.from_jsonl(text + text)
+    assert doubled.root.children["outer"].children["inner"].calls == 4
+
+
+# ---------------------------------------------------------------------- #
+# ambient propagation: contextvars, not a module global
+
+def test_two_interleaved_contexts_do_not_cross_pollute():
+    """The regression the old module-global `_current` had: two logical
+    contexts (threads/tasks) interleaving use_profile must each see their
+    own ambient profile."""
+    seen = {}
+
+    def work(tag, prof):
+        with use_profile(prof):
+            yield  # suspension point: the other context installs ITS profile
+            seen[tag] = get_profile()
+            with get_profile().phase(tag):
+                pass
+            yield
+
+    prof_a, prof_b = PhaseProfile(), PhaseProfile()
+    ctx_a, ctx_b = contextvars.copy_context(), contextvars.copy_context()
+    gen_a, gen_b = work("a", prof_a), work("b", prof_b)
+    ctx_a.run(next, gen_a)  # a installs prof_a
+    ctx_b.run(next, gen_b)  # b installs prof_b (clobbers a module global)
+    ctx_a.run(next, gen_a)  # a resumes AFTER b installed
+    ctx_b.run(next, gen_b)
+    for ctx, gen in ((ctx_a, gen_a), (ctx_b, gen_b)):
+        with pytest.raises(StopIteration):
+            ctx.run(next, gen)  # finish in-context so use_profile unwinds
+    assert seen["a"] is prof_a
+    assert seen["b"] is prof_b
+    assert list(prof_a.counts) == ["a"]
+    assert list(prof_b.counts) == ["b"]
+
+
+def test_ambient_default_is_a_disabled_trace():
+    ambient = get_profile()
+    assert isinstance(ambient, Trace)
+    assert ambient.enabled is False
+    # recording through the disabled ambient is a no-op
+    with ambient.phase("ignored"):
+        pass
+    assert ambient.root.children == {}
+
+
+def test_use_profile_restores_previous_ambient():
+    prof = PhaseProfile()
+    before = get_profile()
+    with use_profile(prof):
+        assert get_profile() is prof
+    assert get_profile() is before
+
+
+# ---------------------------------------------------------------------- #
+# disabled-mode cost: one attribute test, nothing else
+
+def test_disabled_span_is_attribute_test_only(monkeypatch):
+    trace = Trace(enabled=False)
+
+    def boom(*_):
+        raise AssertionError("disabled span touched the clock/ambient state")
+
+    monkeypatch.setattr(spans_mod.time, "perf_counter", boom)
+
+    class _Poisoned:
+        def get(self):
+            raise AssertionError("disabled span read the ambient state")
+
+        def set(self, _):
+            raise AssertionError("disabled span wrote the ambient state")
+
+    monkeypatch.setattr(spans_mod, "_STATE", _Poisoned())
+    with trace.span("x"):
+        pass
+    assert trace.root.children == {}
+
+
+def test_disabled_instruments_do_no_work(monkeypatch):
+    reg = MetricsRegistry()  # disabled by default
+    c, g, h = reg.counter("c"), reg.gauge("g"), reg.histogram("h")
+    monkeypatch.setattr(
+        metrics_mod, "bucket_index",
+        lambda *_: (_ for _ in ()).throw(AssertionError("bucketed while off")),
+    )
+    c.inc(5)
+    g.set(3.0)
+    h.observe(1.0)
+    assert c.value == 0
+    assert g.value == 0.0
+    assert h.count == 0 and h.buckets == {}
+
+
+# ---------------------------------------------------------------------- #
+# metrics registry
+
+def test_registry_get_or_create_shares_instruments_by_name():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_sequential_and_batched_sync_share_instruments():
+    """sync.py and tpu/sync_farm.py fetch the same named counters from the
+    process-wide registry: one set of totals whichever driver runs."""
+    import automerge_tpu.sync as seq
+    import automerge_tpu.tpu.sync_farm as batched
+
+    assert seq._M_MSGS_GEN is batched._M_MSGS_GEN
+    assert seq._M_BLOOM_PROBES is batched._M_BLOOM_PROBES
+
+
+def test_registry_enable_reset_and_render():
+    reg = MetricsRegistry()
+    c = reg.counter("hits", "how many")
+    h = reg.histogram("lat")
+    reg.enable()
+    c.inc(3)
+    h.observe(0.5)
+    assert c.value == 3 and h.count == 1
+    d = reg.as_dict()
+    assert d["hits"] == {"type": "counter", "value": 3}
+    assert d["lat"]["type"] == "histogram" and d["lat"]["count"] == 1
+    table = reg.table()
+    assert "hits" in table and "p50" in table
+    reg.reset()
+    assert c.value == 0 and h.count == 0 and h.buckets == {}
+    # late-created instruments inherit the enabled state
+    late = reg.counter("late")
+    late.inc()
+    assert late.value == 1
+    reg.disable()
+    late.inc()
+    assert late.value == 1
+
+
+def test_enabled_metrics_context_restores_state():
+    reg = MetricsRegistry()
+    with enabled_metrics(reg):
+        assert reg.enabled
+    assert not reg.enabled
+    reg.enable()
+    with enabled_metrics(reg):
+        pass
+    assert reg.enabled  # already-enabled registries stay enabled
+
+
+# ---------------------------------------------------------------------- #
+# integration: farm + engine instrumentation
+
+def _stream(rounds, ops, actor="aaaaaaaa", seed=0):
+    from automerge_tpu.obs.__main__ import _change_stream
+
+    return _change_stream(actor, rounds, ops, seed=seed)
+
+
+def test_farm_phases_flow_through_the_shim():
+    """The bench's pre-existing call pattern: PhaseProfile + use_profile
+    around farm.apply_changes keeps producing the phase breakdown."""
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    farm = TpuDocFarm(2, capacity=32)
+    buf = _stream(1, 4)[0]
+    prof = PhaseProfile()
+    with use_profile(prof):
+        farm.apply_changes([[buf], [buf]])
+    d = prof.as_dict()
+    for phase in ("decode", "gate+transcode", "pack", "device_dispatch",
+                  "visibility", "patch_assembly"):
+        assert phase in d, phase
+        assert d[phase]["calls"] == 1
+
+
+def test_farm_and_engine_metrics_count_real_work():
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        farm = TpuDocFarm(5, capacity=96)
+        for buf in _stream(2, 4):
+            farm.apply_changes([[buf]] * 5)
+    # every op became exactly one dense row: 5 docs x 2 rounds x 4 ops
+    assert reg.counter("farm.rows.transcoded").value == 40
+    # same-width docs => zero padding, occupancy 1.0 observed per call
+    assert reg.counter("farm.rows.padding").value == 0
+    assert reg.gauge("farm.pad_waste_ratio").value == 0.0
+    assert reg.histogram("farm.batch.occupancy").count == 2
+    assert reg.counter("farm.changes.applied").value == 10
+    # each call dispatches one merge + one visibility program
+    dispatches = reg.counter("engine.device.dispatches").value
+    assert dispatches == 4
+    hits = reg.counter("engine.jit.cache_hits").value
+    recompiles = reg.counter("engine.jit.recompiles").value
+    assert hits + recompiles == dispatches
+    assert recompiles >= 1  # fresh shapes compiled at least once
+
+
+def test_farm_pad_waste_with_uneven_docs():
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        farm = TpuDocFarm(2, capacity=32)
+        buf = _stream(1, 4)[0]
+        farm.apply_changes([[buf], []])  # doc 1 contributes zero rows
+    assert reg.counter("farm.rows.transcoded").value == 4
+    assert reg.counter("farm.rows.padding").value == 4
+    assert reg.gauge("farm.pad_waste_ratio").value == pytest.approx(0.5)
+
+
+def test_gate_deferral_and_prevalidation_abort_metrics():
+    from automerge_tpu.columnar import encode_change
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        farm = TpuDocFarm(1, capacity=32)
+        stream = _stream(2, 2)
+        # round 2 without round 1: causally unready, the gate defers it
+        farm.apply_changes([[stream[1]]])
+        assert reg.counter("farm.gate.deferrals").value == 1
+        # an op counter beyond the merge-key packing range aborts the call
+        big = encode_change({
+            "actor": "bbbbbbbb", "seq": 1, "startOp": 1 << 24, "time": 0,
+            "deps": [], "ops": [{"action": "set", "obj": "_root", "key": "k",
+                                 "datatype": "uint", "value": 1, "pred": []}],
+        })
+        with pytest.raises(ValueError):
+            farm.apply_changes([[big]])
+        assert reg.counter("farm.prevalidation.aborts").value == 1
+
+
+# ---------------------------------------------------------------------- #
+# integration: sequential sync protocol metrics
+
+def test_sync_round_trip_metrics():
+    import automerge_tpu.backend as Backend
+    from automerge_tpu.sync import (
+        generate_sync_message,
+        init_sync_state,
+        receive_sync_message,
+    )
+
+    b1, b2 = Backend.init(), Backend.init()
+    b1, _ = Backend.apply_changes(b1, _stream(2, 4, actor="aaaaaaaa"))
+    b2, _ = Backend.apply_changes(b2, _stream(2, 4, actor="cccccccc", seed=7))
+
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        s1, s2 = init_sync_state(), init_sync_state()
+        for _ in range(10):
+            s1, m1 = generate_sync_message(b1, s1)
+            if m1 is not None:
+                b2, s2, _ = receive_sync_message(b2, s2, m1)
+            s2, m2 = generate_sync_message(b2, s2)
+            if m2 is not None:
+                b1, s1, _ = receive_sync_message(b1, s1, m2)
+            if m1 is None and m2 is None:
+                break
+    assert Backend.get_heads(b1) == Backend.get_heads(b2)
+    gen = reg.counter("sync.messages.generated").value
+    assert gen >= 2
+    # every generated message was delivered in this loop
+    assert reg.counter("sync.messages.received").value == gen
+    assert reg.counter("sync.bytes.sent").value == \
+        reg.counter("sync.bytes.received").value > 0
+    assert reg.counter("sync.changes.sent").value == \
+        reg.counter("sync.changes.received").value == 4
+    assert reg.counter("sync.bloom.probes").value > 0
+
+
+# ---------------------------------------------------------------------- #
+# the obs CLI
+
+def test_cli_prints_span_tree_and_metrics_table(capsys):
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--docs", "2", "--rounds", "1", "--ops", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "== spans ==" in out and "== metrics ==" in out
+    assert "p50" in out and "p95" in out and "p99" in out
+    assert "merge" in out and "sync" in out
+    # farm phases appear nested under the workload spans
+    assert "device_dispatch" in out
+    # the metric catalog's headline entries are populated
+    assert "engine.device.dispatches" in out
+    assert "sync.messages.generated" in out
+
+
+def test_cli_dump_and_trace_render_round_trip(tmp_path, capsys):
+    from automerge_tpu.obs.__main__ import main
+
+    dump = tmp_path / "trace.jsonl"
+    assert main(["--docs", "2", "--rounds", "1", "--ops", "4",
+                 "--dump", str(dump)]) == 0
+    capsys.readouterr()
+    # rendering a dump runs no workload (and needs no device layer)
+    assert main(["--trace", str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "merge" in out and "p50" in out
+    assert "== metrics ==" not in out
+
+
+def test_cli_json_output(capsys):
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--json", "--docs", "2", "--rounds", "1", "--ops", "4"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert {s["name"] for s in payload["spans"]} == {"merge", "sync"}
+    assert "engine.device.dispatches" in payload["metrics"]
