@@ -4,13 +4,19 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report
+.PHONY: lint test native obs-report faults
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# the fault-corpus suite: per-doc isolation, quarantine lifecycle, device
+# bisect/fallback, sync survival (tests/test_faults.py). A degradation
+# curve with N% poison docs: `python bench.py --faults N`.
+faults:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
 
 native:
 	$(MAKE) -C native

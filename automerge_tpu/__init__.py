@@ -13,6 +13,17 @@ from . import sync as _sync
 from . import uuid as _uuid_module
 from . import frontend as Frontend
 from .columnar import decode_change, encode_change
+from .errors import (
+    AutomergeError,
+    CausalityError,
+    ChecksumError,
+    DecodeError,
+    DeviceFaultError,
+    EncodeError,
+    PackingLimitError,
+    QuarantinedError,
+    SyncProtocolError,
+)
 from .frontend import (
     Counter,
     Float64,
@@ -45,6 +56,9 @@ __all__ = [
     "get_conflicts", "get_last_local_change", "get_element_ids",
     "Text", "Table", "Counter", "Observable", "Int", "Uint", "Float64",
     "Map", "List",
+    "AutomergeError", "DecodeError", "ChecksumError", "EncodeError",
+    "CausalityError", "PackingLimitError", "SyncProtocolError",
+    "QuarantinedError", "DeviceFaultError",
 ]
 
 _backend = _default_backend  # swappable via set_default_backend()

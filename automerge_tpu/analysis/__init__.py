@@ -28,6 +28,8 @@ Rule families (see core.RULES for the catalog):
 - **AM3xx boundary**: host-only modules importing the device layer
   (AM301), hidden host syncs inside device profiling phases (AM302),
   metric/span recording inside jit/vmap/Pallas-reachable code (AM303).
+- **AM4xx taxonomy**: data-plane modules raising bare ValueError/TypeError
+  instead of classifiable taxonomy errors (AM401).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -40,7 +42,7 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import boundary, obsrules, packing, tracer
+from . import boundary, obsrules, packing, taxonomy, tracer
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -72,7 +74,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
         except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
-    for family in (packing, tracer, boundary, obsrules):
+    for family in (packing, tracer, boundary, obsrules, taxonomy):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

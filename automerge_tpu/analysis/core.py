@@ -52,6 +52,9 @@ RULES: dict[str, tuple[str, str]] = {
     "AM303": ("boundary", "metric/span recording call inside jit/vmap/"
                           "Pallas-reachable code (record on the host "
                           "around the dispatch)"),
+    "AM401": ("taxonomy", "bare ValueError/TypeError raised in a data-plane "
+                          "module (raise a classifiable taxonomy error from "
+                          "automerge_tpu.errors)"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -1,0 +1,71 @@
+"""AM401 — error-taxonomy hygiene: data-plane modules raise classifiable errors.
+
+The fault-isolation layer (tpu/farm.py) routes per-document failures by
+taxonomy class (automerge_tpu/errors.py): ``DecodeError`` means re-request
+the bytes, ``CausalityError`` means distrust the peer, ``PackingLimitError``
+means shed/split — and the obs quarantine counters are dimensioned by
+``error_kind``. A bare ``ValueError``/``TypeError`` raised anywhere on the
+data plane collapses into the ``other`` bucket and strips the isolation
+layer of that signal, so the data-plane modules (codecs, columnar, opset,
+sync, farm, rga, transcode, engines, sync drivers) must raise taxonomy
+errors.
+
+Scope: modules whose filename stem is in ``DATA_PLANE_STEMS``, plus any
+file carrying an ``# amlint: error-taxonomy`` marker (how the test fixtures
+opt in). The frontend and other API-surface modules are deliberately out of
+scope — their errors face the local programmer, not untrusted traffic.
+
+Deliberate bare raises (argument-type validation, API-usage errors,
+internal invariants that indicate a bug rather than bad input) stay bare
+with a justified ``# amlint: disable=AM401`` suppression.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import FileContext, Finding
+
+#: data-plane module stems the rule applies to
+DATA_PLANE_STEMS = frozenset({
+    "codecs", "columnar", "opset", "sync", "farm", "rga",
+    "sync_farm", "sync_batch", "transcode", "engine", "text_engine",
+})
+
+_MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")
+
+#: the stdlib classes whose bare raise loses the error_kind dimension
+_BARE = {"ValueError", "TypeError"}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return (
+        Path(ctx.path).stem in DATA_PLANE_STEMS
+        or _MARKER_RE.search(ctx.source) is not None
+    )
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        if not _in_scope(ctx):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name) and exc.id in _BARE:
+                findings.append(ctx.finding(
+                    "AM401", node,
+                    f"bare {exc.id} raised in a data-plane module: raise a "
+                    "taxonomy error from automerge_tpu.errors (DecodeError/"
+                    "ChecksumError/CausalityError/PackingLimitError/"
+                    "SyncProtocolError/...) so the fault-isolation layer "
+                    "and the error_kind obs dimension can classify it; "
+                    "suppress with a justification where a bare raise is "
+                    "deliberate",
+                ))
+    return findings

@@ -16,17 +16,19 @@ bit-identical output for the same value sequences:
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
+from .errors import DecodeError, EncodeError
+
 MAX_SAFE_INTEGER = 2**53 - 1
 MIN_SAFE_INTEGER = -(2**53 - 1)
 
 
 def hex_to_bytes(value: str) -> bytes:
     if not isinstance(value, str):
-        raise TypeError("value is not a string")
+        raise TypeError("value is not a string")  # amlint: disable=AM401 — argument-type validation
     try:
         return bytes.fromhex(value)
     except ValueError:
-        raise ValueError("value is not hexadecimal") from None
+        raise DecodeError("value is not hexadecimal") from None
 
 
 def bytes_to_hex(data) -> str:
@@ -50,9 +52,9 @@ class Encoder:
     def append_uint(self, value: int, max_bits: int = 64) -> int:
         """LEB128-encode a nonnegative integer. Returns bytes written."""
         if not isinstance(value, int) or isinstance(value, bool):
-            raise ValueError("value is not an integer")
+            raise EncodeError("value is not an integer")
         if value < 0 or value >= (1 << max_bits):
-            raise ValueError("number out of range")
+            raise EncodeError("number out of range")
         n = 0
         while True:
             byte = value & 0x7F
@@ -67,9 +69,9 @@ class Encoder:
     def append_int(self, value: int, max_bits: int = 64) -> int:
         """LEB128-encode a signed integer. Returns bytes written."""
         if not isinstance(value, int) or isinstance(value, bool):
-            raise ValueError("value is not an integer")
+            raise EncodeError("value is not an integer")
         if value < -(1 << (max_bits - 1)) or value >= (1 << (max_bits - 1)):
-            raise ValueError("number out of range")
+            raise EncodeError("number out of range")
         n = 0
         while True:
             byte = value & 0x7F
@@ -88,16 +90,16 @@ class Encoder:
 
     def append_uint53(self, value: int) -> int:
         if not isinstance(value, int) or isinstance(value, bool):
-            raise ValueError("value is not an integer")
+            raise EncodeError("value is not an integer")
         if value < 0 or value > MAX_SAFE_INTEGER:
-            raise ValueError("number out of range")
+            raise EncodeError("number out of range")
         return self.append_uint(value, 64)
 
     def append_int53(self, value: int) -> int:
         if not isinstance(value, int) or isinstance(value, bool):
-            raise ValueError("value is not an integer")
+            raise EncodeError("value is not an integer")
         if value < MIN_SAFE_INTEGER or value > MAX_SAFE_INTEGER:
-            raise ValueError("number out of range")
+            raise EncodeError("number out of range")
         return self.append_int(value, 64)
 
     def append_raw_bytes(self, data) -> int:
@@ -106,7 +108,7 @@ class Encoder:
 
     def append_raw_string(self, value: str) -> int:
         if not isinstance(value, str):
-            raise TypeError("value is not a string")
+            raise TypeError("value is not a string")  # amlint: disable=AM401 — argument-type validation
         return self.append_raw_bytes(value.encode("utf-8", "surrogatepass"))
 
     def append_prefixed_bytes(self, data) -> "Encoder":
@@ -116,7 +118,7 @@ class Encoder:
 
     def append_prefixed_string(self, value: str) -> "Encoder":
         if not isinstance(value, str):
-            raise TypeError("value is not a string")
+            raise TypeError("value is not a string")  # amlint: disable=AM401 — argument-type validation
         self.append_prefixed_bytes(value.encode("utf-8", "surrogatepass"))
         return self
 
@@ -133,7 +135,7 @@ class Decoder:
 
     def __init__(self, buffer):
         if not isinstance(buffer, (bytes, bytearray, memoryview)):
-            raise TypeError(f"Not a byte array: {buffer!r}")
+            raise TypeError(f"Not a byte array: {buffer!r}")  # amlint: disable=AM401 — argument-type validation
         self.buf = bytes(buffer)
         self.offset = 0
 
@@ -146,7 +148,7 @@ class Decoder:
 
     def skip(self, num_bytes: int) -> None:
         if self.offset + num_bytes > len(self.buf):
-            raise ValueError("cannot skip beyond end of buffer")
+            raise DecodeError("cannot skip beyond end of buffer")
         self.offset += num_bytes
 
     def read_byte(self) -> int:
@@ -160,20 +162,20 @@ class Decoder:
         while self.offset < len(self.buf):
             byte = self.buf[self.offset]
             if shift == 63 and byte > 1 and byte != 0x7F:
-                raise ValueError("number out of range")
+                raise DecodeError("number out of range")
             if shift > 63:
-                raise ValueError("number out of range")
+                raise DecodeError("number out of range")
             result |= (byte & 0x7F) << shift
             shift += 7
             self.offset += 1
             if not (byte & 0x80):
                 return result, shift, byte
-        raise ValueError("buffer ended with incomplete number")
+        raise DecodeError("buffer ended with incomplete number")
 
     def read_uint(self, max_bits: int = 64) -> int:
         value, _shift, _last = self._read_leb_bytes()
         if value >= (1 << max_bits):
-            raise ValueError("number out of range")
+            raise DecodeError("number out of range")
         return value
 
     def read_int(self, max_bits: int = 64) -> int:
@@ -181,7 +183,7 @@ class Decoder:
         if last & 0x40 and shift < 70:
             value -= 1 << shift  # sign-extend
         if value < -(1 << (max_bits - 1)) or value >= (1 << (max_bits - 1)):
-            raise ValueError("number out of range")
+            raise DecodeError("number out of range")
         return value
 
     def read_uint32(self) -> int:
@@ -193,19 +195,19 @@ class Decoder:
     def read_uint53(self) -> int:
         value = self.read_uint(64)
         if value > MAX_SAFE_INTEGER:
-            raise ValueError("number out of range")
+            raise DecodeError("number out of range")
         return value
 
     def read_int53(self) -> int:
         value = self.read_int(64)
         if value < MIN_SAFE_INTEGER or value > MAX_SAFE_INTEGER:
-            raise ValueError("number out of range")
+            raise DecodeError("number out of range")
         return value
 
     def read_raw_bytes(self, length: int) -> bytes:
         start = self.offset
         if start + length > len(self.buf):
-            raise ValueError("subarray exceeds buffer size")
+            raise DecodeError("subarray exceeds buffer size")
         self.offset += length
         return self.buf[start : self.offset]
 
@@ -341,7 +343,7 @@ class RLEEncoder(Encoder):
         elif self.type == "utf8":
             self.append_prefixed_string(value)
         else:
-            raise ValueError(f"Unknown RLEEncoder datatype: {self.type}")
+            raise EncodeError(f"Unknown RLEEncoder datatype: {self.type}")
 
     def finish(self) -> None:
         if self.state == "literal":
@@ -380,7 +382,7 @@ class RLEDecoder(Decoder):
         if self.state == "literal":
             value = self._read_raw_value()
             if value == self.last_value:
-                raise ValueError("Repetition of values is not allowed in literal")
+                raise DecodeError("Repetition of values is not allowed in literal")
             self.last_value = value
             return value
         return self.last_value
@@ -413,22 +415,22 @@ class RLEDecoder(Decoder):
         if self.count > 1:
             value = self._read_raw_value()
             if self.state in ("repetition", "literal") and self.last_value == value:
-                raise ValueError("Successive repetitions with the same value are not allowed")
+                raise DecodeError("Successive repetitions with the same value are not allowed")
             self.state = "repetition"
             self.last_value = value
         elif self.count == 1:
-            raise ValueError("Repetition count of 1 is not allowed, use a literal instead")
+            raise DecodeError("Repetition count of 1 is not allowed, use a literal instead")
         elif self.count < 0:
             self.count = -self.count
             if self.state == "literal":
-                raise ValueError("Successive literals are not allowed")
+                raise DecodeError("Successive literals are not allowed")
             self.state = "literal"
         else:
             if self.state == "nulls":
-                raise ValueError("Successive null runs are not allowed")
+                raise DecodeError("Successive null runs are not allowed")
             self.count = self.read_uint53()
             if self.count == 0:
-                raise ValueError("Zero-length null runs are not allowed")
+                raise DecodeError("Zero-length null runs are not allowed")
             self.last_value = None
             self.state = "nulls"
 
@@ -439,7 +441,7 @@ class RLEDecoder(Decoder):
             return self.read_uint53()
         if self.type == "utf8":
             return self.read_prefixed_string()
-        raise ValueError(f"Unknown RLEDecoder datatype: {self.type}")
+        raise DecodeError(f"Unknown RLEDecoder datatype: {self.type}")
 
     def _skip_raw_values(self, num: int) -> None:
         if self.type == "utf8":
@@ -451,7 +453,7 @@ class RLEDecoder(Decoder):
                     num -= 1
                 self.offset += 1
             if num > 0:
-                raise ValueError("cannot skip beyond end of buffer")
+                raise DecodeError("cannot skip beyond end of buffer")
 
 
 class DeltaEncoder(RLEEncoder):
@@ -516,7 +518,7 @@ class BooleanEncoder(Encoder):
 
     def append_value(self, value, repetitions: int = 1) -> None:
         if value is not False and value is not True:
-            raise ValueError(f"Unsupported value for BooleanEncoder: {value}")
+            raise EncodeError(f"Unsupported value for BooleanEncoder: {value}")
         if repetitions <= 0:
             return
         if self.last_value == value:
@@ -558,7 +560,7 @@ class BooleanDecoder(Decoder):
             self.count = self.read_uint53()
             self.last_value = not self.last_value
             if self.count == 0 and not self.first_run:
-                raise ValueError("Zero-length runs are not allowed")
+                raise DecodeError("Zero-length runs are not allowed")
             self.first_run = False
         self.count -= 1
         return self.last_value
@@ -569,7 +571,7 @@ class BooleanDecoder(Decoder):
                 self.count = self.read_uint53()
                 self.last_value = not self.last_value
                 if self.count == 0 and not self.first_run:
-                    raise ValueError("Zero-length runs are not allowed")
+                    raise DecodeError("Zero-length runs are not allowed")
                 self.first_run = False
             consume = min(num_skip, self.count)
             num_skip -= consume

@@ -30,6 +30,7 @@ from .codecs import (
     hex_to_bytes,
 )
 from .common import parse_op_id
+from .errors import ChecksumError, DecodeError, EncodeError
 
 # These bytes don't mean anything, they were generated randomly
 # (columnar.js:24); they identify an Automerge binary container.
@@ -153,12 +154,12 @@ def expand_multi_ops(ops, start_op, actor):
     for op in ops:
         if op.get("action") == "set" and op.get("values") is not None and op.get("insert"):
             if op.get("pred"):
-                raise ValueError("multi-insert pred must be empty")
+                raise EncodeError("multi-insert pred must be empty")
             last_elem_id = op.get("elemId")
             datatype = op.get("datatype")
             for value in op["values"]:
                 if not _valid_datatype(value, datatype):
-                    raise ValueError(
+                    raise EncodeError(
                         f"Decode failed: bad value/datatype association ({value},{datatype})"
                     )
                 new_op = {
@@ -176,7 +177,7 @@ def expand_multi_ops(ops, start_op, actor):
                 op_num += 1
         elif op.get("action") == "del" and op.get("multiOp", 0) > 1:
             if len(op.get("pred", [])) != 1:
-                raise ValueError("multiOp deletion must have exactly one pred")
+                raise EncodeError("multiOp deletion must have exactly one pred")
             start_elem = parse_op_id(op["elemId"])
             start_pred = parse_op_id(op["pred"][0])
             for i in range(op["multiOp"]):
@@ -306,9 +307,9 @@ def encode_value(op, columns):
         num_bytes = columns["valRaw"].append_raw_bytes(value)
         columns["valLen"].append_value(num_bytes << 4 | datatype)
     elif datatype:
-        raise ValueError(f"Unknown datatype {datatype} for value {value}")
+        raise EncodeError(f"Unknown datatype {datatype} for value {value}")
     else:
-        raise ValueError(f"Unsupported value in operation: {value}")
+        raise EncodeError(f"Unsupported value in operation: {value}")
 
 
 def decode_value(size_tag, data):
@@ -330,7 +331,7 @@ def decode_value(size_tag, data):
     if tag == ValueType.IEEE754:
         if len(data) == 8:
             return {"value": struct.unpack("<d", bytes(data))[0], "datatype": "float64"}
-        raise ValueError(f"Invalid length for floating point number: {len(data)}")
+        raise DecodeError(f"Invalid length for floating point number: {len(data)}")
     if tag == ValueType.COUNTER:
         return {"value": Decoder(data).read_int53(), "datatype": "counter"}
     if tag == ValueType.TIMESTAMP:
@@ -374,7 +375,7 @@ def encode_ops(ops, for_document):
             columns["objActor"].append_value(op["obj"].actor_num)
             columns["objCtr"].append_value(op["obj"].counter)
         else:
-            raise ValueError(f"Unexpected objectId reference: {op['obj']}")
+            raise EncodeError(f"Unexpected objectId reference: {op['obj']}")
 
         # keyActor/keyCtr/keyStr
         if op.get("key") is not None:
@@ -390,7 +391,7 @@ def encode_ops(ops, for_document):
             columns["keyCtr"].append_value(op["elemId"].counter)
             columns["keyStr"].append_value(None)
         else:
-            raise ValueError(f"Unexpected operation key: {op}")
+            raise EncodeError(f"Unexpected operation key: {op}")
 
         columns["insert"].append_value(bool(op.get("insert")))
 
@@ -401,7 +402,7 @@ def encode_ops(ops, for_document):
         elif isinstance(action, int):
             columns["action"].append_value(action)
         else:
-            raise ValueError(f"Unexpected operation action: {action}")
+            raise EncodeError(f"Unexpected operation action: {action}")
 
         encode_value(op, columns)
 
@@ -458,7 +459,7 @@ def decode_ops(rows, for_document):
             if row.get("valLen_datatype") is not None:
                 new_op["datatype"] = row["valLen_datatype"]
         if bool(row["chldCtr"] is None) != bool(row["chldActor"] is None):
-            raise ValueError(f"Mismatched child columns: {row['chldCtr']} and {row['chldActor']}")
+            raise DecodeError(f"Mismatched child columns: {row['chldCtr']} and {row['chldActor']}")
         if row["chldCtr"] is not None:
             new_op["child"] = f"{row['chldCtr']}@{row['chldActor']}"
         if for_document:
@@ -476,7 +477,7 @@ def _check_sorted_op_ids(op_ids):
     last = None
     for op_id in op_ids:
         if last is not None and last >= op_id:
-            raise ValueError("operation IDs are not in ascending order")
+            raise DecodeError("operation IDs are not in ascending order")
         last = op_id
 
 
@@ -563,7 +564,7 @@ def _decode_value_columns(columns, col_index, actor_ids, result):
             result[name] = None
         else:
             if actor_num >= len(actor_ids):
-                raise ValueError(f"No actor index {actor_num}")
+                raise DecodeError(f"No actor index {actor_num}")
             result[name] = actor_ids[actor_num]
     else:
         result[name] = col["decoder"].read_value()
@@ -610,7 +611,7 @@ def decode_column_info(decoder):
         column_id = decoder.read_uint53()
         buffer_len = decoder.read_uint53()
         if (column_id & column_id_mask) <= (last & column_id_mask if last >= 0 else -1):
-            raise ValueError("Columns must be in ascending order")
+            raise DecodeError("Columns must be in ascending order")
         last = column_id
         columns.append({"columnId": column_id, "bufferLen": buffer_len})
     return columns
@@ -639,7 +640,7 @@ def encode_container(chunk_type, body: bytes):
 
 def decode_container_header(decoder, compute_hash):
     if decoder.read_raw_bytes(len(MAGIC_BYTES)) != MAGIC_BYTES:
-        raise ValueError("Data does not begin with magic bytes 85 6f 4a 83")
+        raise DecodeError("Data does not begin with magic bytes 85 6f 4a 83")
     expected_hash = decoder.read_raw_bytes(4)
     hash_start = decoder.offset
     chunk_type = decoder.read_byte()
@@ -649,7 +650,7 @@ def decode_container_header(decoder, compute_hash):
     if compute_hash:
         digest = sha256(decoder.buf[hash_start : decoder.offset]).digest()
         if digest[:4] != expected_hash:
-            raise ValueError("checksum does not match data")
+            raise ChecksumError("checksum does not match data")
         header["hash"] = bytes_to_hex(digest)
     return header
 
@@ -682,7 +683,7 @@ def encode_change(change_obj) -> bytes:
     body = Encoder()
     deps = change.get("deps")
     if not isinstance(deps, list):
-        raise TypeError("deps is not an array")
+        raise TypeError("deps is not an array")  # amlint: disable=AM401 — argument-type validation
     body.append_uint53(len(deps))
     for h in sorted(deps):
         body.append_raw_bytes(hex_to_bytes(h))
@@ -705,7 +706,7 @@ def encode_change(change_obj) -> bytes:
 
     hex_hash, data = encode_container(CHUNK_TYPE_CHANGE, body.buffer)
     if change_obj.get("hash") and change_obj["hash"] != hex_hash:
-        raise ValueError(f"Change hash does not match encoding: {change_obj['hash']} != {hex_hash}")
+        raise ChecksumError(f"Change hash does not match encoding: {change_obj['hash']} != {hex_hash}")
     return deflate_change(data) if len(data) >= DEFLATE_MIN_SIZE else data
 
 
@@ -719,15 +720,15 @@ def decode_change_columns(buffer):
     header = decode_container_header(decoder, True)
     chunk = Decoder(header["chunkData"])
     if not decoder.done:
-        raise ValueError("Encoded change has trailing data")
+        raise DecodeError("Encoded change has trailing data")
     if header["chunkType"] != CHUNK_TYPE_CHANGE:
-        raise ValueError(f"Unexpected chunk type: {header['chunkType']}")
+        raise DecodeError(f"Unexpected chunk type: {header['chunkType']}")
 
     change = decode_change_header(chunk)
     columns = decode_column_info(chunk)
     for col in columns:
         if col["columnId"] & COLUMN_TYPE_DEFLATE:
-            raise ValueError("change must not contain deflated columns")
+            raise DecodeError("change must not contain deflated columns")
         col["buffer"] = chunk.read_raw_bytes(col["bufferLen"])
     if not chunk.done:
         change["extraBytes"] = chunk.read_raw_bytes(len(chunk.buf) - chunk.offset)
@@ -856,7 +857,7 @@ def _native_change_ops(cols, actor_ids):
             obj = "_root"
         else:
             if oa == NULLS or oa >= num_actors:
-                raise ValueError(f"No actor index {oa}")
+                raise DecodeError(f"No actor index {oa}")
             obj = f"{oc}@{actor_ids[oa]}"
         ks = None
         if i < key_n and key_offs[i, 0] >= 0:
@@ -871,7 +872,7 @@ def _native_change_ops(cols, actor_ids):
             if key_ctr[i] == NULLS or key_actor[i] == NULLS:
                 return None  # degenerate key row: defer to the generic path
             if key_actor[i] >= num_actors:
-                raise ValueError(f"No actor index {key_actor[i]}")
+                raise DecodeError(f"No actor index {key_actor[i]}")
             elem_id = f"{key_ctr[i]}@{actor_ids[key_actor[i]]}"
         act = int(action[i]) if action[i] != NULLS else None
         act_name = ACTIONS[act] if act is not None and act < len(ACTIONS) else act
@@ -887,14 +888,14 @@ def _native_change_ops(cols, actor_ids):
             if decoded.get("datatype") is not None:
                 op["datatype"] = decoded["datatype"]
         if (chld_ctr[i] == NULLS) != (chld_actor[i] == NULLS):
-            raise ValueError(
+            raise DecodeError(
                 "Mismatched child columns: "
                 f"{None if chld_ctr[i] == NULLS else chld_ctr[i]} and "
                 f"{None if chld_actor[i] == NULLS else chld_actor[i]}"
             )
         if chld_ctr[i] != NULLS:
             if chld_actor[i] >= num_actors:
-                raise ValueError(f"No actor index {chld_actor[i]}")
+                raise DecodeError(f"No actor index {chld_actor[i]}")
             op["child"] = f"{chld_ctr[i]}@{actor_ids[chld_actor[i]]}"
         np_ = int(pred_num[i]) if pred_num[i] != NULLS else 0
         pred = []
@@ -903,10 +904,10 @@ def _native_change_ops(cols, actor_ids):
             pa, pc = pred_actor[pi], pred_ctr[pi]
             pi += 1
             if pa >= num_actors:
-                raise ValueError(f"No actor index {pa}")
+                raise DecodeError(f"No actor index {pa}")
             key = (int(pc), actor_ids[pa])
             if last is not None and last >= key:
-                raise ValueError("operation IDs are not in ascending order")
+                raise DecodeError("operation IDs are not in ascending order")
             last = key
             pred.append(f"{pc}@{actor_ids[pa]}")
         op["pred"] = pred
@@ -934,7 +935,7 @@ def decode_change_meta(buffer, compute_hash):
         buffer = inflate_change(buffer)
     header = decode_container_header(Decoder(buffer), compute_hash)
     if header["chunkType"] != CHUNK_TYPE_CHANGE:
-        raise ValueError("Buffer chunk type is not a change")
+        raise DecodeError("Buffer chunk type is not a change")
     meta = decode_change_header(Decoder(header["chunkData"]))
     meta["change"] = buffer
     if compute_hash:
@@ -945,7 +946,7 @@ def decode_change_meta(buffer, compute_hash):
 def deflate_change(buffer: bytes) -> bytes:
     header = decode_container_header(Decoder(buffer), False)
     if header["chunkType"] != CHUNK_TYPE_CHANGE:
-        raise ValueError(f"Unexpected chunk type: {header['chunkType']}")
+        raise DecodeError(f"Unexpected chunk type: {header['chunkType']}")
     compressed = deflate_raw(header["chunkData"])
     out = Encoder()
     out.append_raw_bytes(buffer[:8])  # copy MAGIC_BYTES and checksum
@@ -958,7 +959,7 @@ def deflate_change(buffer: bytes) -> bytes:
 def inflate_change(buffer: bytes) -> bytes:
     header = decode_container_header(Decoder(buffer), False)
     if header["chunkType"] != CHUNK_TYPE_DEFLATE:
-        raise ValueError(f"Unexpected chunk type: {header['chunkType']}")
+        raise DecodeError(f"Unexpected chunk type: {header['chunkType']}")
     decompressed = inflate_raw(header["chunkData"])
     out = Encoder()
     out.append_raw_bytes(buffer[:8])
@@ -1009,17 +1010,17 @@ def group_change_ops(changes, ops):
         change["ops"] = []
         changes_by_actor.setdefault(change["actor"], [])
         if change["seq"] != len(changes_by_actor[change["actor"]]) + 1:
-            raise ValueError(
+            raise DecodeError(
                 f"Expected seq = {len(changes_by_actor[change['actor']]) + 1}, got {change['seq']}"
             )
         if change["seq"] > 1 and changes_by_actor[change["actor"]][change["seq"] - 2]["maxOp"] > change["maxOp"]:
-            raise ValueError("maxOp must increase monotonically per actor")
+            raise DecodeError("maxOp must increase monotonically per actor")
         changes_by_actor[change["actor"]].append(change)
 
     ops_by_id = {}
     for op in ops:
         if op["action"] == "del":
-            raise ValueError("document should not contain del operations")
+            raise DecodeError("document should not contain del operations")
         op["pred"] = ops_by_id[op["id"]]["pred"] if op["id"] in ops_by_id else []
         ops_by_id[op["id"]] = op
         for succ in op["succ"]:
@@ -1050,7 +1051,7 @@ def group_change_ops(changes, ops):
             else:
                 right = index
         if left >= len(actor_changes):
-            raise ValueError(f"Operation ID {op['id']} outside of allowed range")
+            raise DecodeError(f"Operation ID {op['id']} outside of allowed range")
         actor_changes[left]["ops"].append(op)
 
     for change in changes:
@@ -1060,7 +1061,7 @@ def group_change_ops(changes, ops):
         for i, op in enumerate(change["ops"]):
             expected_id = f"{change['startOp'] + i}@{change['actor']}"
             if op["id"] != expected_id:
-                raise ValueError(f"Expected opId {expected_id}, got {op['id']}")
+                raise DecodeError(f"Expected opId {expected_id}, got {op['id']}")
             del op["id"]
 
 
@@ -1073,7 +1074,7 @@ def decode_document_changes(changes, expected_heads):
         for dep in change["depsNum"]:
             index = dep["depsIndex"]
             if index >= len(changes) or "hash" not in changes[index]:
-                raise ValueError(f"No hash for index {index} while processing index {i}")
+                raise DecodeError(f"No hash for index {index} while processing index {i}")
             h = changes[index]["hash"]
             change["deps"].append(h)
             heads.pop(h, None)
@@ -1081,7 +1082,7 @@ def decode_document_changes(changes, expected_heads):
         del change["depsNum"]
 
         if change.get("extraLen_datatype") != ValueType.BYTES:
-            raise ValueError(f"Bad datatype for extra bytes: {ValueType.BYTES}")
+            raise DecodeError(f"Bad datatype for extra bytes: {ValueType.BYTES}")
         change["extraBytes"] = change["extraLen"]
         change.pop("extraLen_datatype", None)
         change.pop("extraLen", None)
@@ -1092,7 +1093,7 @@ def decode_document_changes(changes, expected_heads):
 
     actual_heads = sorted(heads.keys())
     if actual_heads != sorted(expected_heads):
-        raise ValueError(
+        raise ChecksumError(
             f"Mismatched heads hashes: expected {', '.join(expected_heads)}, "
             f"got {', '.join(actual_heads)}"
         )
@@ -1136,9 +1137,9 @@ def decode_document_header(buffer):
     header = decode_container_header(doc_decoder, True)
     decoder = Decoder(header["chunkData"])
     if not doc_decoder.done:
-        raise ValueError("Encoded document has trailing data")
+        raise DecodeError("Encoded document has trailing data")
     if header["chunkType"] != CHUNK_TYPE_DOCUMENT:
-        raise ValueError(f"Unexpected chunk type: {header['chunkType']}")
+        raise DecodeError(f"Unexpected chunk type: {header['chunkType']}")
 
     actor_ids = [decoder.read_hex_string() for _ in range(decoder.read_uint53())]
     num_heads = decoder.read_uint53()

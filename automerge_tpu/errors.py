@@ -1,0 +1,95 @@
+"""Error taxonomy for the merge pipeline: classifiable faults, compatible bases.
+
+The farm's north star is untrusted multi-user traffic at batch scale, where
+"a ValueError happened" is useless: the fault-isolation layer (tpu/farm.py)
+must decide per document whether a delivery was structurally corrupt
+(re-request it), causally invalid (quarantine the peer), or over a packing
+limit (shed/split), and the obs counters need an ``error_kind`` dimension.
+This module is the single vocabulary for those decisions.
+
+Every concrete class multiply inherits the exception type the pre-taxonomy
+code raised (``ValueError``/``TypeError``), so existing callers and tests
+that catch the stdlib types keep working; new code should catch
+``AutomergeError`` or a specific subclass. amlint rule AM401 enforces that
+the data-plane modules (codecs, columnar, opset, sync, farm, rga, ...)
+raise taxonomy errors rather than bare stdlib ones.
+
+Hierarchy::
+
+    AutomergeError
+    ├── DecodeError(ValueError)        structurally invalid bytes
+    │   └── ChecksumError              container checksum / hash mismatch
+    ├── EncodeError(ValueError)        unencodable value / malformed op dict
+    ├── CausalityError(ValueError)     seq reuse/skip, unknown pred/dep/ref
+    ├── PackingLimitError(ValueError)  merge-key / MAX_ELEMS / interner caps
+    ├── SyncProtocolError(ValueError)  malformed or inapplicable peer message
+    └── QuarantinedError               delivery shed: the doc is quarantined
+"""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
+from __future__ import annotations
+
+
+class AutomergeError(Exception):
+    """Root of the taxonomy. ``kind`` is the obs/error-report dimension."""
+
+    kind = "other"
+
+
+class DecodeError(AutomergeError, ValueError):
+    """Bytes that are not a structurally valid chunk/column/varint."""
+
+    kind = "decode"
+
+
+class ChecksumError(DecodeError):
+    """Container checksum (or change-hash) does not match the data."""
+
+    kind = "checksum"
+
+
+class EncodeError(AutomergeError, ValueError):
+    """A value or op dict that cannot be encoded into the wire format."""
+
+    kind = "encode"
+
+
+class CausalityError(AutomergeError, ValueError):
+    """Causally invalid history: sequence number reuse or skip, duplicate
+    opIds, predecessors/dependencies/list references that do not exist."""
+
+    kind = "causality"
+
+
+class PackingLimitError(AutomergeError, ValueError):
+    """A device packing range would overflow: op counters beyond the
+    merge-key range, list elements beyond the rank kernel's MAX_ELEMS, or
+    an interner table past its bit-field cap."""
+
+    kind = "packing"
+
+
+class SyncProtocolError(AutomergeError, ValueError):
+    """A peer sync message that is malformed or cannot be applied; local
+    state is left untouched by the rejecting call."""
+
+    kind = "sync"
+
+
+class DeviceFaultError(AutomergeError):
+    """The batched device program failed with this document's rows in the
+    batch (isolated by the farm's dispatch bisection)."""
+
+    kind = "device"
+
+
+class QuarantinedError(AutomergeError):
+    """Delivery shed without processing: the target document is in the
+    farm's quarantine set (see ``TpuDocFarm.release_quarantine``)."""
+
+    kind = "quarantined"
+
+
+def error_kind(exc: BaseException) -> str:
+    """The ``error_kind`` dimension for one exception: the taxonomy class's
+    ``kind``, or ``"other"`` for exceptions outside the taxonomy."""
+    return getattr(exc, "kind", "other") if isinstance(exc, AutomergeError) else "other"

@@ -47,6 +47,7 @@ from .columnar import (
 )
 from .codecs import Encoder
 from .common import parse_op_id, utf16_key
+from .errors import CausalityError, DecodeError
 
 # Row field indices, matching the doc/change column layout (new.js:10-12)
 OBJ_ACTOR, OBJ_CTR, KEY_ACTOR, KEY_CTR, KEY_STR = 0, 1, 2, 3, 4
@@ -157,15 +158,15 @@ def convert_insert_to_update(edits, index, elem_id):
         last = edits[-1]
         if last["action"] == "insert":
             if last["index"] != index:
-                raise ValueError("last edit has unexpected index")
+                raise ValueError("last edit has unexpected index")  # amlint: disable=AM401 — internal edit-stream invariant, not a data fault
             updates.insert(0, edits.pop())
             break
         elif last["action"] == "update":
             if last["index"] != index:
-                raise ValueError("last edit has unexpected index")
+                raise ValueError("last edit has unexpected index")  # amlint: disable=AM401 — internal edit-stream invariant, not a data fault
             updates.insert(0, edits.pop())
         else:
-            raise ValueError("last edit has unexpected action")
+            raise ValueError("last edit has unexpected action")  # amlint: disable=AM401 — internal edit-stream invariant, not a data fault
     first_update = True
     for update in updates:
         append_update(edits, index, elem_id, update["opId"], update["value"], first_update)
@@ -221,9 +222,9 @@ def _read_op_rows(columns, column_spec, actor_table=None):
     for i, (name, column_id) in enumerate(column_spec):
         if i < len(decoders) and decoders[i]["columnId"] != column_id:
             # Unknown column present before a standard one; unsupported for now
-            raise ValueError("unexpected columnId")
+            raise DecodeError("unexpected columnId")
     if len(decoders) != len(column_spec):
-        raise ValueError("unexpected columnId")
+        raise DecodeError("unexpected columnId")
 
     ds = [d["decoder"] for d in decoders]
     action_d = ds[ACTION]
@@ -262,14 +263,14 @@ def _get_actor_table(actor_ids, change):
     actor indexes (new.js:1434)."""
     if change["actorIds"][0] not in actor_ids:
         if change["seq"] != 1:
-            raise ValueError(f"Seq {change['seq']} is the first change for actor {change['actorIds'][0]}")
+            raise CausalityError(f"Seq {change['seq']} is the first change for actor {change['actorIds'][0]}")
         actor_ids = actor_ids + [change["actorIds"][0]]
     actor_table = []
     for actor_id in change["actorIds"]:
         try:
             actor_table.append(actor_ids.index(actor_id))
         except ValueError:
-            raise ValueError(f"actorId {actor_id} is not known to document") from None
+            raise CausalityError(f"actorId {actor_id} is not known to document") from None
     return actor_ids, actor_table
 
 
@@ -307,13 +308,13 @@ def _read_next_change_op(doc_state, change_state):
     change_state.next_op = op
 
     if (op[OBJ_CTR] is None) != (op[OBJ_ACTOR] is None):
-        raise ValueError(f"Mismatched object reference: ({op[OBJ_CTR]}, {op[OBJ_ACTOR]})")
+        raise DecodeError(f"Mismatched object reference: ({op[OBJ_CTR]}, {op[OBJ_ACTOR]})")
     if (
         (op[KEY_CTR] is None and op[KEY_ACTOR] is not None)
         or (op[KEY_CTR] == 0 and op[KEY_ACTOR] is not None)
         or (op[KEY_CTR] is not None and op[KEY_CTR] > 0 and op[KEY_ACTOR] is None)
     ):
-        raise ValueError(f"Mismatched operation key: ({op[KEY_CTR]}, {op[KEY_ACTOR]})")
+        raise DecodeError(f"Mismatched operation key: ({op[KEY_CTR]}, {op[KEY_ACTOR]})")
 
 
 def _seek_to_op(doc_state, ops):
@@ -434,7 +435,7 @@ def _seek_to_op(doc_state, ops):
                 or next_id_actor != key_actor
                 or not next_insert
             ):
-                raise ValueError(f"Reference element not found: {key_ctr}@{key_actor}")
+                raise CausalityError(f"Reference element not found: {key_ctr}@{key_actor}")
             if next_insert:
                 elem_visible = False
             if next_succ_num == 0 and not elem_visible:
@@ -509,7 +510,7 @@ def _seek_to_op(doc_state, ops):
             or next_id_actor != key_actor
             or not next_insert
         ):
-            raise ValueError(f"Reference element not found: {key_ctr}@{key_actor}")
+            raise CausalityError(f"Reference element not found: {key_ctr}@{key_actor}")
 
     return skip_count, visible_count
 
@@ -589,7 +590,7 @@ def _update_patch_property(patches, object_id, op, doc_state, prop_state, list_i
 
     elif is_inc:
         if "counterStates" not in state or op_id not in state["counterStates"]:
-            raise ValueError(f"increment operation {op_id} for unknown counter")
+            raise CausalityError(f"increment operation {op_id} for unknown counter")
         counter_state = state["counterStates"][op_id]
         counter_state["value"] += decode_value(op[VAL_LEN], op[VAL_RAW])["value"]
         del counter_state["succs"][op_id]
@@ -628,7 +629,7 @@ def _update_patch_property(patches, object_id, op, doc_state, prop_state, list_i
             elif state.get("action") == "remove":
                 last_edit = patch["edits"][-1]
                 if last_edit["action"] != "remove":
-                    raise ValueError("last edit has unexpected type")
+                    raise ValueError("last edit has unexpected type")  # amlint: disable=AM401 — internal edit-stream invariant, not a data fault
                 if last_edit["count"] > 1:
                     last_edit["count"] -= 1
                 else:
@@ -816,7 +817,7 @@ def _merge_doc_change_ops(patches, out_rows, change_state, doc_state, list_index
         ):
             take_change_ops = len(change_ops)
             if not in_correct_object and not found_list_elem and change_op[KEY_STR] is None and not change_op[INSERT]:
-                raise ValueError(
+                raise CausalityError(
                     "could not find list element with ID: "
                     f"{change_op[KEY_CTR]}@{actor_ids[change_op[KEY_ACTOR]]}"
                 )
@@ -867,7 +868,7 @@ def _merge_doc_change_ops(patches, out_rows, change_state, doc_state, list_index
                         change_ops.pop(i)
                         pred_seen.pop(i)
             elif doc_op[ID_CTR] == change_op[ID_CTR] and actor_ids[doc_op[ID_ACTOR]] == id_actor:
-                raise ValueError(f"duplicate operation ID: {change_op[ID_CTR]}@{id_actor}")
+                raise CausalityError(f"duplicate operation ID: {change_op[ID_CTR]}@{id_actor}")
             else:
                 take_change_ops = 1
         else:
@@ -887,7 +888,7 @@ def _merge_doc_change_ops(patches, out_rows, change_state, doc_state, list_index
                 op = change_ops[i]
                 for j in range(op[PRED_NUM]):
                     if not pred_seen[i][j]:
-                        raise ValueError(
+                        raise CausalityError(
                             "no matching operation for pred: "
                             f"{op[PRED_CTR][j]}@{actor_ids[op[PRED_ACTOR][j]]}"
                         )
@@ -1021,12 +1022,12 @@ def _apply_change_batch(patches, decoded_changes, doc_state, object_ids, throw_e
             enqueued.append(change)
         elif change["seq"] < expected_seq:
             if throw_exceptions:
-                raise ValueError(
+                raise CausalityError(
                     f"Reuse of sequence number {change['seq']} for actor {change['actor']}"
                 )
             return [], decoded_changes
         elif change["seq"] > expected_seq:
-            raise ValueError(f"Skipped sequence number {expected_seq} for actor {change['actor']}")
+            raise CausalityError(f"Skipped sequence number {expected_seq} for actor {change['actor']}")
         else:
             clock[change["actor"]] = change["seq"]
             change_hashes.add(change["hash"])
@@ -1152,7 +1153,7 @@ class OpSet:
             actor_id = row["actor"]
             seq = row["seq"]
             if seq != 1 and seq != clock.get(actor_id, 0) + 1:
-                raise ValueError(f"Expected seq {clock.get(actor_id, 0) + 1}, got {seq} for actor {actor_id}")
+                raise CausalityError(f"Expected seq {clock.get(actor_id, 0) + 1}, got {seq} for actor {actor_id}")
             clock[actor_id] = seq
             head_indexes.add(i)
             deps_indexes = [d["depsIndex"] for d in row["depsNum"]]
@@ -1204,7 +1205,12 @@ class OpSet:
 
         patches = {"_root": {"objectId": "_root", "type": "map", "props": {}}}
         doc_state = _DocState(self)
-        doc_state.change_index_by_hash = self.change_index_by_hash
+        # Work on a copy of the hash index so a delivery that raises midway
+        # (seq reuse in a later gate batch, a corrupt change) cannot leave
+        # phantom hashes behind: the committed index is only swapped in at
+        # the commit point below (error-path atomicity for the sync layer
+        # and the farm's per-doc quarantine).
+        doc_state.change_index_by_hash = dict(self.change_index_by_hash)
 
         queue = decoded_changes if not self.queue else decoded_changes + self.queue
         all_applied = []
@@ -1227,11 +1233,16 @@ class OpSet:
                 if self.have_hash_graph:
                     break
                 self.compute_hash_graph()
-                doc_state.change_index_by_hash = self.change_index_by_hash
+                doc_state.change_index_by_hash = dict(self.change_index_by_hash)
+                for i, change in enumerate(all_applied):
+                    doc_state.change_index_by_hash[change["hash"]] = (
+                        len(self.changes) + i
+                    )
 
         _setup_patches(patches, object_ids, doc_state)
 
         # Commit (only reached if no exception was raised)
+        self.change_index_by_hash = doc_state.change_index_by_hash
         for change in all_applied:
             self.changes.append(change["buffer"])
             self.hashes_by_actor.setdefault(change["actor"], [])
@@ -1303,7 +1314,7 @@ class OpSet:
             self.hashes_by_actor[change["actor"]].append(change["hash"])
             expected_seq = self.clock.get(change["actor"], 0) + 1
             if change["seq"] != expected_seq:
-                raise ValueError(
+                raise CausalityError(
                     f"Expected seq {expected_seq}, got seq {change['seq']} from actor {change['actor']}"
                 )
             self.clock[change["actor"]] = change["seq"]
@@ -1323,7 +1334,7 @@ class OpSet:
             seen_hashes[h] = True
             successors = self.dependents_by_hash.get(h)
             if successors is None:
-                raise ValueError(f"hash not found: {h}")
+                raise CausalityError(f"hash not found: {h}")
             stack.extend(successors)
 
         while stack:
@@ -1344,7 +1355,7 @@ class OpSet:
             if h not in seen_hashes:
                 deps = self.dependencies_by_hash.get(h)
                 if deps is None:
-                    raise ValueError(f"hash not found: {h}")
+                    raise CausalityError(f"hash not found: {h}")
                 stack.extend(deps)
                 seen_hashes[h] = True
 

@@ -16,7 +16,9 @@ from math import ceil
 from . import backend as Backend
 from .codecs import Decoder, Encoder, bytes_to_hex, hex_to_bytes
 from .columnar import decode_change_meta
+from .errors import AutomergeError, EncodeError, SyncProtocolError
 from .obs.metrics import get_metrics
+from .testing.faults import fire as _fault_point
 
 HASH_SIZE = 32
 MESSAGE_TYPE_SYNC = 0x42
@@ -64,6 +66,11 @@ _M_BLOOM_FP = _METRICS.counter(
     "Bloom positives contradicted by an explicit peer need (changes the "
     "filter wrongly claimed the peer already had)",
 )
+_M_REJECTED = _METRICS.counter(
+    "sync.messages.rejected",
+    "received sync messages rejected as malformed or inapplicable "
+    "(SyncProtocolError; local state untouched)",
+)
 
 
 class BloomFilter:
@@ -94,7 +101,7 @@ class BloomFilter:
                     decoder.read_raw_bytes(ceil(self.num_entries * self.num_bits_per_entry / 8))
                 )
         else:
-            raise TypeError("invalid argument")
+            raise TypeError("invalid argument")  # amlint: disable=AM401 — argument-type validation
 
     @property
     def bytes(self) -> bytes:
@@ -113,7 +120,7 @@ class BloomFilter:
         hash_bytes = hex_to_bytes(hash_)
         modulo = 8 * len(self.bits)
         if len(hash_bytes) != 32:
-            raise ValueError(f"Not a 256-bit hash: {hash_}")
+            raise SyncProtocolError(f"Not a 256-bit hash: {hash_}")
         x = int.from_bytes(hash_bytes[0:4], "little") % modulo
         y = int.from_bytes(hash_bytes[4:8], "little") % modulo
         z = int.from_bytes(hash_bytes[8:12], "little") % modulo
@@ -143,14 +150,14 @@ class BloomFilter:
 
 def _encode_hashes(encoder, hashes):
     if not isinstance(hashes, list):
-        raise TypeError("hashes must be a list")
+        raise TypeError("hashes must be a list")  # amlint: disable=AM401 — argument-type validation
     encoder.append_uint32(len(hashes))
     for i, h in enumerate(hashes):
         if i > 0 and hashes[i - 1] >= h:
-            raise ValueError("hashes must be sorted")
+            raise EncodeError("hashes must be sorted")
         data = hex_to_bytes(h)
         if len(data) != HASH_SIZE:
-            raise TypeError("heads hashes must be 256 bits")
+            raise TypeError("heads hashes must be 256 bits")  # amlint: disable=AM401 — argument-type validation
         encoder.append_raw_bytes(data)
 
 
@@ -177,7 +184,7 @@ def decode_sync_message(data):
     decoder = Decoder(data)
     message_type = decoder.read_byte()
     if message_type != MESSAGE_TYPE_SYNC:
-        raise ValueError(f"Unexpected message type: {message_type}")
+        raise SyncProtocolError(f"Unexpected message type: {message_type}")
     heads = _decode_hashes(decoder)
     need = _decode_hashes(decoder)
     have_count = decoder.read_uint32()
@@ -206,7 +213,7 @@ def decode_sync_state(data):
     decoder = Decoder(data)
     record_type = decoder.read_byte()
     if record_type != PEER_STATE_TYPE:
-        raise ValueError(f"Unexpected record type: {record_type}")
+        raise SyncProtocolError(f"Unexpected record type: {record_type}")
     shared_heads = _decode_hashes(decoder)
     state = init_sync_state()
     state["sharedHeads"] = shared_heads
@@ -291,9 +298,9 @@ def generate_sync_message(backend, sync_state):
     """Generates the next message to send to a peer, or None if in sync
     (sync.js:327). Returns (sync_state, message_bytes_or_None)."""
     if backend is None:
-        raise ValueError("generate_sync_message called with no Automerge document")
+        raise ValueError("generate_sync_message called with no Automerge document")  # amlint: disable=AM401 — API-usage validation
     if sync_state is None:
-        raise ValueError("generate_sync_message requires a sync_state, created by init_sync_state()")
+        raise ValueError("generate_sync_message requires a sync_state, created by init_sync_state()")  # amlint: disable=AM401 — API-usage validation
 
     shared_heads = sync_state["sharedHeads"]
     last_sent_heads = sync_state["lastSentHeads"]
@@ -360,22 +367,43 @@ def receive_sync_message(backend, old_sync_state, binary_message):
     """Processes a received sync message; returns (backend, sync_state, patch)
     (sync.js:420)."""
     if backend is None:
-        raise ValueError("receive_sync_message called with no Automerge document")
+        raise ValueError("receive_sync_message called with no Automerge document")  # amlint: disable=AM401 — API-usage validation
     if old_sync_state is None:
-        raise ValueError("receive_sync_message requires a sync_state, created by init_sync_state()")
+        raise ValueError("receive_sync_message requires a sync_state, created by init_sync_state()")  # amlint: disable=AM401 — API-usage validation
 
     shared_heads = old_sync_state["sharedHeads"]
     last_sent_heads = old_sync_state["lastSentHeads"]
     sent_hashes = old_sync_state["sentHashes"]
     patch = None
-    message = decode_sync_message(binary_message)
+    # A malformed peer message must not poison local state: reject with
+    # SyncProtocolError, leaving the backend handle usable (not frozen) and
+    # the caller's sync_state object untouched. Raw decode exceptions from
+    # corrupt bytes (DecodeError/ChecksumError, or an IndexError from a
+    # short buffer) never propagate out of this function.
+    try:
+        _fault_point("sync.receive_message", message=binary_message)
+        message = decode_sync_message(binary_message)
+    except SyncProtocolError:
+        _M_REJECTED.inc()
+        raise
+    except (ValueError, TypeError, IndexError) as exc:
+        _M_REJECTED.inc()
+        raise SyncProtocolError(f"malformed sync message: {exc}") from exc
     _M_MSGS_RECV.inc()
     _M_BYTES_RECV.inc(len(binary_message))
     _M_CHANGES_RECV.inc(len(message["changes"]))
     before_heads = Backend.get_heads(backend)
 
     if message["changes"]:
-        backend, patch = Backend.apply_changes(backend, message["changes"])
+        try:
+            backend, patch = Backend.apply_changes(backend, message["changes"])
+        except (AutomergeError, ValueError, KeyError, IndexError) as exc:
+            # OpSet.apply_changes commits only after a clean run, so the
+            # backend state is untouched here
+            _M_REJECTED.inc()
+            raise SyncProtocolError(
+                f"sync message carried inapplicable changes: {exc}"
+            ) from exc
         shared_heads = _advance_heads(before_heads, Backend.get_heads(backend), shared_heads)
 
     if not message["changes"] and message["heads"] == before_heads:

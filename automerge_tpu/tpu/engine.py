@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs.metrics import get_metrics
+from ..testing.faults import fire as _fault_point
 
 PAD_KEY = jnp.iinfo(jnp.int32).max
 ACTOR_BITS = 20
@@ -346,6 +347,7 @@ class BatchedMapEngine:
         self.state = make_empty_state(num_docs, capacity)
 
     def apply_batch(self, changes: ChangeOpsBatch) -> BatchedDocState:
+        _fault_point("engine.apply_batch", changes=changes)
         needed = int(jnp.max(self.state.num_ops)) + changes.key.shape[1]
         while needed > self.capacity:
             self.capacity *= 2
@@ -355,6 +357,7 @@ class BatchedMapEngine:
         return self.state
 
     def visible_state(self, actor_rank=None):
+        _fault_point("engine.visible_state")
         return batched_visible_state(self.state, actor_rank=actor_rank)
 
 

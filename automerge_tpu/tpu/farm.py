@@ -38,6 +38,16 @@ byte-exact by construction. The device engine still carries every doc's
 rows (including list rows: element forests feed the batched RGA rank
 kernel in rga.py) for whole-document visibility, conflict winners,
 counter totals, and the sync kernels at batch scale.
+
+Fault isolation: under the default ``isolation="doc"`` every document is
+its own fault domain — a poisoned delivery (corrupt bytes, causal
+violations, packing overflows) quarantines only that doc, with its host
+state rolled back to a pre-call snapshot and the failure classified by the
+error taxonomy (errors.py) in the call's outcome report. Repeat offenders
+enter a traffic-shedding quarantine set (release_quarantine restores
+them), and a failing device dispatch degrades to the sequential reference
+walk after bisecting out the poison docs. ``isolation="batch"`` keeps the
+historical all-or-nothing contract. See README "Fault isolation".
 """
 from __future__ import annotations
 
@@ -47,8 +57,16 @@ import numpy as np
 
 from ..columnar import decode_change, decode_change_meta
 from ..common import utf16_key
+from ..errors import (
+    CausalityError,
+    DeviceFaultError,
+    PackingLimitError,
+    QuarantinedError,
+    error_kind,
+)
 from ..obs.metrics import get_metrics
 from ..opset import OpSet
+from ..testing.faults import fire as _fault_point
 from .engine import (
     ACTION_DEL,
     ACTION_INC,
@@ -106,6 +124,50 @@ _M_DEFERRALS = _METRICS.counter(
 _M_WALKS = _METRICS.counter(
     "farm.exact.walks", "documents served by the embedded reference walk"
 )
+_M_Q_ENTERED = _METRICS.counter(
+    "farm.quarantine.entered",
+    "documents moved into the quarantine set after repeated failures",
+)
+_M_Q_RELEASED = _METRICS.counter(
+    "farm.quarantine.released", "documents returned to service"
+)
+_M_Q_SHED = _METRICS.counter(
+    "farm.quarantine.shed",
+    "deliveries dropped unprocessed because the target doc is quarantined",
+)
+_M_Q_ACTIVE = _METRICS.gauge(
+    "farm.quarantine.active", "documents currently quarantined"
+)
+_M_FB_CALLS = _METRICS.counter(
+    "farm.fallback.calls",
+    "apply_changes calls that lost the batched device path mid-dispatch",
+)
+_M_FB_DOCS = _METRICS.counter(
+    "farm.fallback.docs",
+    "documents served by the sequential reference walk after a device failure",
+)
+_M_BISECT = _METRICS.counter(
+    "farm.bisect.rounds",
+    "bisection probes run to isolate device-poison documents",
+)
+
+# One counter family for every per-doc quarantine cause, dimensioned by the
+# taxonomy's error_kind (decode/checksum/causality/packing/device/...): the
+# single funnel for "why did a doc lose this delivery", replacing the old
+# split where only prevalidation aborts were counted (the batch-wide
+# `farm.prevalidation.aborts` counter still tracks isolation="batch" aborts).
+_QUARANTINE_CAUSES: dict[str, object] = {}
+
+
+def _quarantine_cause(kind: str):
+    counter = _QUARANTINE_CAUSES.get(kind)
+    if counter is None:
+        counter = _METRICS.counter(
+            f"farm.quarantine.causes.{kind}",
+            f"per-doc quarantined deliveries with error_kind={kind}",
+        )
+        _QUARANTINE_CAUSES[kind] = counter
+    return counter
 
 _MAKE_TYPES = {
     "makeMap": "map",
@@ -121,10 +183,46 @@ def _empty_object_patch(object_id, type_):
     return {"objectId": object_id, "type": type_, "props": {}}
 
 
-class TpuDocFarm:
-    """N documents, one device engine. See module docstring."""
+class DocOutcome(NamedTuple):
+    """Per-document result of one apply_changes call (isolation="doc")."""
 
-    def __init__(self, num_docs: int, capacity: int = 1024):
+    status: str                       # "applied" | "quarantined"
+    error: BaseException | None = None
+    error_kind: str | None = None     # taxonomy dimension (errors.error_kind)
+    offending_hashes: tuple = ()      # change hashes implicated, if known
+    fallback: bool = False            # served by the sequential walk
+
+
+_APPLIED = DocOutcome("applied")
+_APPLIED_FALLBACK = DocOutcome("applied", fallback=True)
+
+
+class FarmApplyResult(list):
+    """apply_changes' return value: the per-doc patch list every existing
+    caller indexes into, plus the per-doc outcome report."""
+
+    def __init__(self, patches, outcomes):
+        super().__init__(patches)
+        self.outcomes = list(outcomes)
+
+    @property
+    def quarantined(self):
+        """{doc index: DocOutcome} of the docs that lost this delivery."""
+        return {
+            d: o for d, o in enumerate(self.outcomes) if o.status == "quarantined"
+        }
+
+
+class TpuDocFarm:
+    """N documents, one device engine. See module docstring.
+
+    `quarantine_threshold`: consecutive failed deliveries after which a
+    document enters the quarantine set and sheds its traffic until
+    `release_quarantine` (None disables the set; every failure still
+    quarantines that one delivery)."""
+
+    def __init__(self, num_docs: int, capacity: int = 1024,
+                 quarantine_threshold: int | None = 3):
         self.num_docs = num_docs
         self.engine = BatchedMapEngine(num_docs, capacity)
         # interners are shared across the batch: actor ids, (objectId, key)
@@ -174,6 +272,13 @@ class TpuDocFarm:
         # targets a list/text object (see module docstring): authoritative
         # for that doc's incremental patch stream from then on
         self.exact: list[OpSet | None] = [None] * num_docs
+        # fault-isolation state (isolation="doc"): consecutive failure
+        # streaks, the quarantine set (doc -> last cause), and docs pinned
+        # to the sequential walk after a device-path failure
+        self.quarantine_threshold = quarantine_threshold
+        self.fault_counts = [0] * num_docs
+        self.quarantine: dict[int, BaseException] = {}
+        self.degraded: set[int] = set()
 
     # ------------------------------------------------------------------ #
     # transcoding
@@ -195,7 +300,7 @@ class TpuDocFarm:
             return self._list_op_rows(d, op, ctr, actor)
         obj, key = op["obj"], op["key"]
         if obj not in self.object_meta[d]:
-            raise ValueError(f"op for missing object {obj}")
+            raise CausalityError(f"op for missing object {obj}")
         slot = self.slots.intern((obj, key))
         packed = (ctr << ACTOR_BITS) | self.actors.intern(actor)
         preds = [self._pack_opid(p) for p in op.get("pred", ())]
@@ -250,7 +355,7 @@ class TpuDocFarm:
         from . import rga
 
         if needed > rga.MAX_ELEMS:
-            raise ValueError(
+            raise PackingLimitError(
                 f"document exceeds {rga.MAX_ELEMS} list elements (incl. "
                 "tombstones): beyond the rank kernel's key-packing range"
             )
@@ -276,9 +381,9 @@ class TpuDocFarm:
         obj = op["obj"]
         meta = self.object_meta[d].get(obj)
         if meta is None:
-            raise ValueError(f"op for missing object {obj}")
+            raise CausalityError(f"op for missing object {obj}")
         if meta["type"] not in ("list", "text"):
-            raise ValueError(f"list op for non-list object {obj}")
+            raise CausalityError(f"list op for non-list object {obj}")
         packed = (ctr << ACTOR_BITS) | self.actors.intern(actor)
         preds = [self._pack_opid(p) for p in op.get("pred", ())]
         action = op["action"]
@@ -296,8 +401,10 @@ class TpuDocFarm:
             self.elem_opid[d, idx] = packed
             if ref == "_head":
                 self.elem_parent[d, idx] = -1
-            else:
+            elif ref in self.elem_index[d]:
                 self.elem_parent[d, idx] = self.elem_index[d][ref]
+            else:
+                raise CausalityError(f"unknown list element {ref}")
             self.elem_index[d][elem_id] = idx
             self.elem_ids[d].append(elem_id)
             self.elem_object[d].append(obj)
@@ -305,7 +412,7 @@ class TpuDocFarm:
         else:
             key_elem = op["elemId"]
             if key_elem not in self.elem_index[d]:
-                raise ValueError(f"unknown list element {key_elem}")
+                raise CausalityError(f"unknown list element {key_elem}")
         slot = self.slots.intern((obj, key_elem))
 
         if action == "set":
@@ -459,13 +566,17 @@ class TpuDocFarm:
             if not ready:
                 enqueued.append(change)
             elif change["seq"] < expected_seq:
-                raise ValueError(
+                exc = CausalityError(
                     f"Reuse of sequence number {change['seq']} for actor {change['actor']}"
                 )
+                exc.offending_hashes = (change["hash"],)
+                raise exc
             elif change["seq"] > expected_seq:
-                raise ValueError(
+                exc = CausalityError(
                     f"Skipped sequence number {expected_seq} for actor {change['actor']}"
                 )
+                exc.offending_hashes = (change["hash"],)
+                raise exc
             else:
                 clock[change["actor"]] = change["seq"]
                 round_hashes.add(change["hash"])
@@ -514,18 +625,24 @@ class TpuDocFarm:
         deliveries never re-apply, so their inserts must not trigger a
         spurious rejection).
 
-        Abort semantics are BATCH-WIDE: the pre-pass runs for every doc
-        before any doc's ops are transcoded or committed, so one over-limit
-        document fails the whole apply_changes call and every document in
-        the batch stays untouched. The queue estimate is deliberately
-        conservative — a permanently-stuck queued change with inserts keeps
-        shrinking the doc's effective element budget (readiness is
-        unknowable without running the causal gate), which can reject a
-        delivery that would have fit; split the batch to isolate such a
-        doc."""
+        Abort semantics depend on the isolation mode (apply_changes): under
+        the default isolation="doc", an over-limit document quarantines ONLY
+        its own delivery (state untouched, reported in the call's outcome
+        list) while the rest of the batch proceeds; under the
+        isolation="batch" escape hatch the pre-pass keeps the historical
+        all-or-nothing contract — it runs for every doc before any doc's
+        ops are transcoded or committed, so one over-limit document fails
+        the whole call and every document stays untouched. The queue
+        estimate is deliberately conservative — a permanently-stuck queued
+        change with inserts keeps shrinking the doc's effective element
+        budget (readiness is unknowable without running the causal gate),
+        which can reject a delivery that would have fit; under "doc"
+        isolation such a doc quarantines itself (see release_quarantine)
+        instead of poisoning its batch neighbours."""
         from . import rga
 
         inserts = 0
+        insert_hashes = set()
         seen = set()
         for change in list(decoded_changes) + list(self.queue[d]):
             if change["hash"] in self.change_index_by_hash[d] or change["hash"] in seen:
@@ -534,31 +651,59 @@ class TpuDocFarm:
             ctr = change["startOp"]
             for op in change["ops"]:
                 if ctr >= rga.MAX_COUNTER:
-                    raise ValueError(
+                    exc = PackingLimitError(
                         f"op counter {ctr} exceeds the merge-key "
                         "packing range"
                     )
+                    exc.offending_hashes = (change["hash"],)
+                    raise exc
                 if op.get("insert"):
                     inserts += 1
+                    insert_hashes.add(change["hash"])
                 ctr += 1
         if int(self.num_elems[d]) + inserts > rga.MAX_ELEMS:
-            raise ValueError(
+            exc = PackingLimitError(
                 f"document exceeds {rga.MAX_ELEMS} list elements (incl. "
                 "tombstones): beyond the rank kernel's key-packing range"
             )
+            exc.offending_hashes = tuple(sorted(insert_hashes))
+            raise exc
 
     # ------------------------------------------------------------------ #
     # the batched applyChanges step
 
-    def apply_changes(self, per_doc_buffers, is_local=False):
+    def apply_changes(self, per_doc_buffers, is_local=False, isolation="doc"):
         """Applies binary changes to every document (one device merge for
-        the whole batch) and returns one reference-format patch per doc.
-        `per_doc_buffers` is a list of num_docs lists of change buffers.
+        the whole batch) and returns one reference-format patch per doc
+        (a FarmApplyResult: a plain list of patches carrying a per-doc
+        `outcomes` report). `per_doc_buffers` is a list of num_docs lists
+        of change buffers.
+
+        Isolation modes:
+        - ``"doc"`` (default): decode, prevalidation, walk and gate
+          failures are captured PER DOCUMENT — healthy docs proceed
+          through transcode/pack/device dispatch in the same call, the
+          failing doc's state stays untouched (snapshot/rollback around
+          the commit phase) and its outcome reports
+          ``quarantined(error, offending_hashes)``. Docs failing
+          `quarantine_threshold` consecutive deliveries enter the
+          quarantine set and shed traffic until `release_quarantine`.
+          If the batched device program itself fails mid-dispatch, the
+          batch is bisected to isolate the poison doc(s) and the
+          survivors are served through the sequential reference walk
+          (degraded mode), so the call still returns patches.
+        - ``"batch"``: the historical all-or-nothing contract — the first
+          failure raises out of the call (prevalidation aborts the whole
+          batch before anything commits).
 
         Phases (recorded on the ambient PhaseProfile, SURVEY §5.1):
         decode -> walk (exact docs) -> gate+transcode -> pack ->
         device_dispatch -> visibility -> patch_assembly."""
         from ..profiling import get_profile
+
+        if isolation not in ("doc", "batch"):
+            raise ValueError(f"unknown isolation mode: {isolation!r}")  # amlint: disable=AM401 — API-usage validation
+        doc_mode = isolation == "doc"
 
         prof = get_profile()
         assert len(per_doc_buffers) == self.num_docs
@@ -567,15 +712,66 @@ class TpuDocFarm:
         touched_objects = [set() for _ in range(self.num_docs)]
         applied_changes = [[] for _ in range(self.num_docs)]
         exact_patches: dict[int, dict] = {}
+        # fault-domain state for this call (isolation="doc")
+        failures: dict[int, BaseException] = {}
+        snapshots: dict[int, dict] = {}
+        fallback_docs: set[int] = set()
+        attempted = [d for d in range(self.num_docs) if per_doc_buffers[d]]
+
+        def quarantine(d, exc):
+            """Captures one doc's failure: rolls its state back, drops its
+            rows/patch work, and counts the cause by error_kind."""
+            if d in snapshots:
+                self._restore_doc(d, snapshots.pop(d))
+            failures[d] = exc
+            per_doc_decoded[d] = []
+            per_doc_rows[d] = []
+            applied_ops[d] = []
+            touched_objects[d] = set()
+            applied_changes[d] = []
+            exact_patches.pop(d, None)
+            _quarantine_cause(error_kind(exc)).inc()
+            self.fault_counts[d] += 1
+            if (
+                self.quarantine_threshold is not None
+                and self.fault_counts[d] >= self.quarantine_threshold
+                and d not in self.quarantine
+            ):
+                self.quarantine[d] = exc
+                _M_Q_ENTERED.inc()
+                _M_Q_ACTIVE.set(len(self.quarantine))
+
+        # quarantined docs shed their traffic before any work happens
+        if doc_mode and self.quarantine:
+            per_doc_buffers = list(per_doc_buffers)
+            for d, cause in self.quarantine.items():
+                if per_doc_buffers[d]:
+                    per_doc_buffers[d] = []
+                    failures[d] = QuarantinedError(
+                        f"document {d} is quarantined after "
+                        f"{self.fault_counts[d]} failed deliveries (last "
+                        f"cause: {cause}); release_quarantine({d}) to "
+                        "restore traffic"
+                    )
+                    _M_Q_SHED.inc()
 
         with prof.phase("decode"):
             per_doc_decoded = []
-            for buffers in per_doc_buffers:
+            for d, buffers in enumerate(per_doc_buffers):
                 decoded = []
-                for buffer in buffers:
-                    change = decode_change(buffer)
-                    change["buffer"] = bytes(buffer)
-                    decoded.append(change)
+                try:
+                    _fault_point("farm.decode", doc=d, buffers=buffers)
+                    for buffer in buffers:
+                        change = decode_change(buffer)
+                        change["buffer"] = bytes(buffer)
+                        decoded.append(change)
+                except Exception as exc:
+                    if not doc_mode:
+                        raise
+                    decoded = []
+                    per_doc_decoded.append(decoded)
+                    quarantine(d, exc)
+                    continue
                 per_doc_decoded.append(decoded)
 
         # Docs receiving no changes this call skip prevalidation entirely:
@@ -584,13 +780,16 @@ class TpuDocFarm:
         # doc commits, so re-scanning the queue would be O(queue ops) of
         # redundant work per call (ADVICE round 5). Docs that do receive
         # changes still re-scan their queue inside _prevalidate_limits.
-        try:
-            for d, decoded in enumerate(per_doc_decoded):
-                if decoded:
-                    self._prevalidate_limits(d, decoded)
-        except ValueError:
-            _M_ABORTS.inc()
-            raise
+        for d, decoded in enumerate(per_doc_decoded):
+            if not decoded:
+                continue
+            try:
+                self._prevalidate_limits(d, decoded)
+            except ValueError as exc:
+                if not doc_mode:
+                    _M_ABORTS.inc()
+                    raise
+                quarantine(d, exc)
 
         # list/text-targeting docs route through the reference walk, whose
         # patch is authoritative for them (byte-exact edit streams; see
@@ -602,55 +801,74 @@ class TpuDocFarm:
                 if decoded and (
                     self.exact[d] is not None or self._targets_list(decoded)
                 ):
-                    self._ensure_exact(d)
-                    exact_patches[d] = self.exact[d].apply_changes(
-                        [c["buffer"] for c in decoded], is_local
-                    )
+                    try:
+                        self._ensure_exact(d)
+                        exact_patches[d] = self.exact[d].apply_changes(
+                            [c["buffer"] for c in decoded], is_local
+                        )
+                    except Exception as exc:
+                        if not doc_mode:
+                            raise
+                        # the walk bootstrap/apply may be mid-flight;
+                        # rebuild lazily from the committed log
+                        self.exact[d] = None
+                        quarantine(d, exc)
 
         with prof.phase("gate+transcode"):
             for d, decoded in enumerate(per_doc_decoded):
+                if doc_mode and decoded:
+                    snapshots[d] = self._snapshot_doc(d)
                 pending = decoded + self.queue[d] if self.queue[d] else decoded
                 gate_batch = 0
-                while True:
-                    applied, pending = self._gate_round(d, pending)
-                    if not applied:
-                        break
-                    gate_batch += 1
-                    for change in applied:
-                        ctr = change["startOp"]
-                        for op in change["ops"]:
-                            rows = self._op_rows(d, op, ctr, change["actor"])
-                            per_doc_rows[d].extend(rows)
-                            applied_ops[d].append(
-                                (op, ctr, change["actor"], gate_batch)
+                try:
+                    while True:
+                        applied, pending = self._gate_round(d, pending)
+                        if not applied:
+                            break
+                        gate_batch += 1
+                        for change in applied:
+                            ctr = change["startOp"]
+                            for op in change["ops"]:
+                                rows = self._op_rows(d, op, ctr, change["actor"])
+                                per_doc_rows[d].extend(rows)
+                                applied_ops[d].append(
+                                    (op, ctr, change["actor"], gate_batch)
+                                )
+                                touched_objects[d].add(op["obj"])
+                                ctr += 1
+                            self.max_op[d] = max(self.max_op[d], ctr - 1)
+                            applied_changes[d].append(change)
+                            # commit immediately so later gate rounds (and
+                            # later calls) see this hash as a satisfied
+                            # dependency
+                            self.changes[d].append(change["buffer"])
+                            self.change_index_by_hash[d][change["hash"]] = (
+                                len(self.changes[d]) - 1
                             )
-                            touched_objects[d].add(op["obj"])
-                            ctr += 1
-                        self.max_op[d] = max(self.max_op[d], ctr - 1)
-                        applied_changes[d].append(change)
-                        # commit immediately so later gate rounds (and later
-                        # calls) see this hash as a satisfied dependency
-                        self.changes[d].append(change["buffer"])
-                        self.change_index_by_hash[d][change["hash"]] = (
-                            len(self.changes[d]) - 1
-                        )
-                        by_actor = self.hashes_by_actor[d].setdefault(
-                            change["actor"], []
-                        )
-                        while len(by_actor) < change["seq"]:
-                            by_actor.append(None)
-                        by_actor[change["seq"] - 1] = change["hash"]
-                        self.dependencies_by_hash[d][change["hash"]] = list(
-                            change["deps"]
-                        )
-                        self.dependents_by_hash[d].setdefault(change["hash"], [])
-                        for dep in change["deps"]:
-                            self.dependents_by_hash[d].setdefault(dep, []).append(
-                                change["hash"]
+                            by_actor = self.hashes_by_actor[d].setdefault(
+                                change["actor"], []
                             )
-                    if not pending:
-                        break
-                self.queue[d] = pending
+                            while len(by_actor) < change["seq"]:
+                                by_actor.append(None)
+                            by_actor[change["seq"] - 1] = change["hash"]
+                            self.dependencies_by_hash[d][change["hash"]] = list(
+                                change["deps"]
+                            )
+                            self.dependents_by_hash[d].setdefault(change["hash"], [])
+                            for dep in change["deps"]:
+                                self.dependents_by_hash[d].setdefault(dep, []).append(
+                                    change["hash"]
+                                )
+                        if not pending:
+                            break
+                    self.queue[d] = pending
+                except Exception as exc:
+                    if not doc_mode:
+                        raise
+                    # exact walk state (if any) committed the delivery the
+                    # farm is rolling back; rebuild it lazily
+                    self.exact[d] = None
+                    quarantine(d, exc)
 
         if _METRICS.enabled:
             _M_WALKS.inc(len(exact_patches))
@@ -667,6 +885,7 @@ class TpuDocFarm:
 
         # one device merge for the whole batch
         width = max((len(r) for r in per_doc_rows), default=0)
+        device_failed = False
         if width > 0:
             if _METRICS.enabled:
                 rows = sum(len(r) for r in per_doc_rows)
@@ -676,36 +895,86 @@ class TpuDocFarm:
                 _M_PAD_RATIO.set(1.0 - rows / cells)
                 _M_OCCUPANCY.observe(rows / cells)
             with prof.phase("pack"):
-                keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
-                ops = np.zeros((self.num_docs, width), np.int64)
-                actions = np.zeros((self.num_docs, width), np.int32)
-                values = np.zeros((self.num_docs, width), np.int64)
-                preds = np.full((self.num_docs, width), -1, np.int64)
-                for d, rows in enumerate(per_doc_rows):
-                    for i, (slot, packed, action, value, pred) in enumerate(rows):
-                        keys[d, i] = slot
-                        ops[d, i] = packed
-                        actions[d, i] = action
-                        values[d, i] = value
-                        preds[d, i] = pred
+                batch = self._pack_rows(per_doc_rows, width=width)
             with prof.phase("device_dispatch"):
-                self.engine.apply_batch(
-                    changes_from_numpy(keys, ops, actions, values, preds)
+                active = tuple(
+                    d for d in range(self.num_docs) if per_doc_rows[d]
                 )
+                try:
+                    _fault_point("farm.device_dispatch", docs=active)
+                    self.engine.apply_batch(batch)
+                except Exception as exc:
+                    if not doc_mode:
+                        raise
+                    # Degraded mode: the batched device path is gone for
+                    # this call. Bisect to find the doc(s) whose rows
+                    # poison the program; quarantine them (host state
+                    # rolled back) and serve every survivor through the
+                    # sequential reference walk below.
+                    device_failed = True
+                    _M_FB_CALLS.inc()
+                    poison = self._bisect_device_faults(per_doc_rows, active)
+                    for d in sorted(poison):
+                        quarantine(d, DeviceFaultError(
+                            f"batched device dispatch fails with document "
+                            f"{d}'s rows in the batch: {exc}"
+                        ))
+                    fallback_docs.update(d for d in active if d not in poison)
 
-        # no-op deliveries (all queued or duplicates) need no device work
+        if device_failed:
+            with prof.phase("fallback_walk"):
+                for d in sorted(fallback_docs):
+                    try:
+                        if d in exact_patches:
+                            # the walk already produced this call's patch;
+                            # just pin the doc to walk-served mode
+                            self.degraded.add(d)
+                        else:
+                            exact_patches[d] = self._fallback_walk(
+                                d,
+                                snapshots.get(d),
+                                [c["buffer"] for c in per_doc_decoded[d]],
+                                is_local,
+                            )
+                        _M_FB_DOCS.inc()
+                    except Exception as exc:
+                        quarantine(d, exc)
+
+        # no-op deliveries (all queued or duplicates) need no device work;
+        # after a device failure nothing may touch the device again this
+        # call (every doc with applied rows is fallback- or quarantine-
+        # served, so the remaining docs' patches are device-independent)
         need_device_patch = [
-            d for d in range(self.num_docs) if d not in exact_patches
+            d for d in range(self.num_docs)
+            if d not in exact_patches and d not in failures
         ]
         with prof.phase("visibility"):
             vis = (
                 self._read_visibility()
-                if width > 0 and need_device_patch
+                if width > 0 and need_device_patch and not device_failed
                 else None
             )
         with prof.phase("patch_assembly"):
             patches = []
+            outcomes = []
             for d in range(self.num_docs):
+                if d in failures:
+                    exc = failures[d]
+                    patches.append(self._noop_patch(d))
+                    outcomes.append(DocOutcome(
+                        "quarantined",
+                        error=exc,
+                        error_kind=error_kind(exc),
+                        offending_hashes=tuple(
+                            getattr(exc, "offending_hashes", ())
+                        ),
+                    ))
+                    continue
+                if d in attempted:
+                    self.fault_counts[d] = 0  # a clean delivery ends the streak
+                outcomes.append(
+                    _APPLIED_FALLBACK if d in fallback_docs else _APPLIED
+                )
                 if d in exact_patches:
                     patches.append(exact_patches[d])
                     continue
@@ -726,7 +995,180 @@ class TpuDocFarm:
                     patch["actor"] = applied_changes[d][0]["actor"]
                     patch["seq"] = applied_changes[d][0]["seq"]
                 patches.append(patch)
-        return patches
+        return FarmApplyResult(patches, outcomes)
+
+    # ------------------------------------------------------------------ #
+    # fault domains: snapshot/rollback, quarantine, degraded-mode fallback
+
+    def _snapshot_doc(self, d: int) -> dict:
+        """Captures doc `d`'s mutable host state before the commit phase.
+        Containers the gate replaces wholesale (heads/clock/queue) are kept
+        by reference; containers it mutates in place are shallow-copied.
+        The element arrays need only their live count: rows past
+        num_elems[d] are dead (masked by the valid range) and the next
+        insert overwrites them."""
+        return {
+            "object_meta": dict(self.object_meta[d]),
+            "clock": self.clock[d],
+            "heads": self.heads[d],
+            "queue": self.queue[d],
+            "changes_len": len(self.changes[d]),
+            "change_index": dict(self.change_index_by_hash[d]),
+            "hashes_by_actor": {
+                k: list(v) for k, v in self.hashes_by_actor[d].items()
+            },
+            "deps_by_hash": {
+                k: list(v) for k, v in self.dependencies_by_hash[d].items()
+            },
+            "dependents": {
+                k: list(v) for k, v in self.dependents_by_hash[d].items()
+            },
+            "max_op": self.max_op[d],
+            "counter_ops": set(self.counter_ops[d]),
+            "inc_max": dict(self.inc_max[d]),
+            "starved": set(self.starved[d]),
+            "num_elems": int(self.num_elems[d]),
+            "elem_index": dict(self.elem_index[d]),
+            "elem_ids": list(self.elem_ids[d]),
+            "elem_object": list(self.elem_object[d]),
+        }
+
+    def _restore_doc(self, d: int, snap: dict) -> None:
+        """Rolls doc `d` back to its snapshot (quarantine path). Shared
+        interner entries created by the rolled-back transcode are left
+        behind deliberately: they are append-only lookup tables, never
+        document state."""
+        self.object_meta[d] = snap["object_meta"]
+        self.clock[d] = snap["clock"]
+        self.heads[d] = snap["heads"]
+        self.queue[d] = snap["queue"]
+        del self.changes[d][snap["changes_len"]:]
+        self.change_index_by_hash[d] = snap["change_index"]
+        self.hashes_by_actor[d] = snap["hashes_by_actor"]
+        self.dependencies_by_hash[d] = snap["deps_by_hash"]
+        self.dependents_by_hash[d] = snap["dependents"]
+        self.max_op[d] = snap["max_op"]
+        self.counter_ops[d] = snap["counter_ops"]
+        self.inc_max[d] = snap["inc_max"]
+        self.starved[d] = snap["starved"]
+        self.num_elems[d] = snap["num_elems"]
+        self.elem_index[d] = snap["elem_index"]
+        self.elem_ids[d] = snap["elem_ids"]
+        self.elem_object[d] = snap["elem_object"]
+
+    def _noop_patch(self, d: int) -> dict:
+        """The patch of a delivery that changed nothing (quarantined/shed):
+        current clock/heads, empty diffs."""
+        return {
+            "maxOp": self.max_op[d],
+            "clock": self.clock[d],
+            "deps": self.heads[d],
+            "pendingChanges": len(self.queue[d]),
+            "diffs": _empty_object_patch("_root", "map"),
+        }
+
+    def _pack_rows(self, per_doc_rows, width=None, only=None):
+        """Packs per-doc dense rows into padded device tensors. `only`
+        restricts to a subset of docs (others all-padding) for bisection
+        probes."""
+        if width is None:
+            width = max((len(r) for r in per_doc_rows), default=0) or 1
+        keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
+        ops = np.zeros((self.num_docs, width), np.int64)
+        actions = np.zeros((self.num_docs, width), np.int32)
+        values = np.zeros((self.num_docs, width), np.int64)
+        preds = np.full((self.num_docs, width), -1, np.int64)
+        for d, rows in enumerate(per_doc_rows):
+            if only is not None and d not in only:
+                continue
+            for i, (slot, packed, action, value, pred) in enumerate(rows):
+                keys[d, i] = slot
+                ops[d, i] = packed
+                actions[d, i] = action
+                values[d, i] = value
+                preds[d, i] = pred
+        return changes_from_numpy(keys, ops, actions, values, preds)
+
+    def _bisect_device_faults(self, per_doc_rows, active):
+        """Isolates the doc(s) whose rows crash the batched device program
+        by bisection: each probe dispatches a subset's rows against a
+        throwaway copy of the engine state (the real state is never
+        advanced here). Returns the poison doc set; `farm.bisect.rounds`
+        counts probes."""
+        import jax
+        import jax.numpy as jnp
+
+        from .engine import batched_apply_ops
+
+        def probe_ok(group):
+            _M_BISECT.inc()
+            try:
+                _fault_point("farm.device_dispatch", docs=tuple(group))
+                state = jax.tree_util.tree_map(jnp.copy, self.engine.state)
+                out = batched_apply_ops(
+                    state, self._pack_rows(per_doc_rows, only=set(group))
+                )
+                jax.block_until_ready(out)
+                return True
+            except Exception:
+                return False
+
+        poison = set()
+        stack = [sorted(active)]
+        while stack:
+            group = stack.pop()
+            if probe_ok(group):
+                continue
+            if len(group) == 1:
+                poison.add(group[0])
+                continue
+            mid = len(group) // 2
+            stack.append(group[:mid])
+            stack.append(group[mid:])
+        if poison == set(active):
+            # every doc "poison" means the device itself is down, not the
+            # data: blame nobody and serve the whole batch sequentially
+            return set()
+        return poison
+
+    def _fallback_walk(self, d, snap, delivered_buffers, is_local):
+        """Serves one device-failure survivor through the sequential
+        reference walk: replays the doc's pre-call committed log and queue
+        into a fresh OpSet, applies this call's delivery for the patch, and
+        pins the doc to walk-served (degraded) mode from now on — its
+        device rows are stale after the lost dispatch, so the embedded
+        walk becomes authoritative for patches AND whole-doc reads
+        (get_patch)."""
+        opset = OpSet()
+        committed = (
+            self.changes[d][: snap["changes_len"]]
+            if snap is not None
+            else list(self.changes[d])
+        )
+        if committed:
+            opset.apply_changes(list(committed))
+        queued = snap["queue"] if snap is not None else self.queue[d]
+        for change in queued:
+            opset.apply_changes([change["buffer"]])
+        patch = opset.apply_changes(list(delivered_buffers), is_local)
+        self.exact[d] = opset
+        self.degraded.add(d)
+        return patch
+
+    def release_quarantine(self, doc: int | None = None):
+        """Returns quarantined doc(s) to service (all of them when `doc` is
+        None) and resets their failure streaks. Returns the released doc
+        indexes."""
+        docs = list(self.quarantine) if doc is None else [doc]
+        released = []
+        for d in docs:
+            if d in self.quarantine:
+                del self.quarantine[d]
+                self.fault_counts[d] = 0
+                released.append(d)
+                _M_Q_RELEASED.inc()
+        _M_Q_ACTIVE.set(len(self.quarantine))
+        return released
 
     # ------------------------------------------------------------------ #
     # patch assembly from device visibility
@@ -964,6 +1406,10 @@ class TpuDocFarm:
     # whole-document patch (getPatch, new.js:2052)
 
     def get_patch(self, d: int):
+        # degraded docs lost device rows to a failed dispatch; their
+        # embedded walk is authoritative for whole-doc reads too
+        if d in self.degraded and self.exact[d] is not None:
+            return self.exact[d].get_patch()
         vis = self._read_visibility()
         ranks = (
             self._element_ranks() if int(self.num_elems[d]) > 0 else None
@@ -1040,7 +1486,7 @@ class TpuDocFarm:
             seen.add(h)
             successors = self.dependents_by_hash[d].get(h)
             if successors is None:
-                raise ValueError(f"hash not found: {h}")
+                raise CausalityError(f"hash not found: {h}")
             stack.extend(successors)
         while stack:
             h = stack.pop()
@@ -1057,7 +1503,7 @@ class TpuDocFarm:
             if h not in seen:
                 deps = self.dependencies_by_hash[d].get(h)
                 if deps is None:
-                    raise ValueError(f"hash not found: {h}")
+                    raise CausalityError(f"hash not found: {h}")
                 stack.extend(deps)
                 seen.add(h)
         return [

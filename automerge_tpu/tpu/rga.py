@@ -47,6 +47,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..errors import PackingLimitError
 from .engine import remap_opid_actors
 
 # Packed opIds are (counter << 20 | actor), 44 significant bits. The
@@ -158,7 +159,7 @@ def batched_rga_rank(parent, opid, valid, actor_rank):
     Returns int32[docs, E] ranks; invalid slots get E.
     """
     if parent.shape[-1] > MAX_ELEMS:
-        raise ValueError(
+        raise PackingLimitError(
             f"document element table exceeds the rank kernel's "
             f"MAX_ELEMS={MAX_ELEMS}; the sibling-sort key packing would "
             "overflow int64"

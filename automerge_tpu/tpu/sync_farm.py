@@ -29,6 +29,7 @@ from math import ceil
 import numpy as np
 
 from ..columnar import decode_change_meta
+from ..errors import SyncProtocolError
 from ..obs.metrics import get_metrics
 from ..sync import (
     BITS_PER_ENTRY,
@@ -62,6 +63,7 @@ _M_NEED_REQUESTED = _METRICS.counter("sync.changes.need_requested")
 _M_BLOOM_PROBES = _METRICS.counter("sync.bloom.probes")
 _M_BLOOM_HITS = _METRICS.counter("sync.bloom.hits")
 _M_BLOOM_FP = _METRICS.counter("sync.bloom.false_positives")
+_M_REJECTED = _METRICS.counter("sync.messages.rejected")
 
 
 def filters_from_bytes(blobs):
@@ -78,7 +80,7 @@ def filters_from_bytes(blobs):
         if p.num_entries and (
             p.num_probes != NUM_PROBES or p.num_bits_per_entry != BITS_PER_ENTRY
         ):
-            raise ValueError(
+            raise SyncProtocolError(
                 "non-default Bloom parameters require the host BloomFilter path"
             )
     num_words = max(
@@ -358,30 +360,59 @@ class SyncFarm:
         channel's changes through ONE batched farm.applyChanges call (docs
         repeated across channels fall back to per-channel application to
         preserve per-message head accounting). Returns
-        [(new_state, patch|None)] in channel order."""
+        [(new_state, patch|None)] in channel order.
+
+        One bad peer must not abort the batched round: a channel whose
+        message fails to decode is rejected in place — its result is
+        ``(unchanged state, None)``, counted on ``sync.messages.rejected``
+        — and a channel whose changes poison its document is handled by
+        the farm's per-doc isolation (the doc quarantines, the patch is a
+        no-op, every other channel proceeds)."""
         farm = self.farm
-        decoded = [decode_sync_message(m) for _, _, m in channels_msgs]
+        decoded = []
+        rejected = 0
+        for _, _, m in channels_msgs:
+            try:
+                decoded.append(decode_sync_message(m))
+            except (SyncProtocolError, ValueError, TypeError, IndexError):
+                decoded.append(None)
+                rejected += 1
         if _METRICS.enabled:
-            _M_MSGS_RECV.inc(len(channels_msgs))
-            _M_BYTES_RECV.inc(sum(len(m) for _, _, m in channels_msgs))
-            _M_CHANGES_RECV.inc(sum(len(m["changes"]) for m in decoded))
+            _M_MSGS_RECV.inc(len(channels_msgs) - rejected)
+            _M_REJECTED.inc(rejected)
+            _M_BYTES_RECV.inc(sum(
+                len(m)
+                for (_, _, m), msg in zip(channels_msgs, decoded)
+                if msg is not None
+            ))
+            _M_CHANGES_RECV.inc(
+                sum(len(m["changes"]) for m in decoded if m is not None)
+            )
         docs = [d for d, _, _ in channels_msgs]
-        if len(set(docs)) != len(docs):
+        live_docs = [
+            d for (d, _, _), msg in zip(channels_msgs, decoded)
+            if msg is not None
+        ]
+        if len(set(live_docs)) != len(live_docs):
             return [
-                self._receive_one(d, s, msg)
+                (s, None) if msg is None else self._receive_one(d, s, msg)
                 for (d, s, _), msg in zip(channels_msgs, decoded)
             ]
 
         before = {d: farm.get_heads(d) for d in docs}
         patches = [None] * farm.num_docs
-        if any(msg["changes"] for msg in decoded):
+        if any(msg and msg["changes"] for msg in decoded):
             per_doc = [[] for _ in range(farm.num_docs)]
             for d, msg in zip(docs, decoded):
-                per_doc[d] = list(msg["changes"])
+                if msg is not None:
+                    per_doc[d] = list(msg["changes"])
             patches = farm.apply_changes(per_doc)
 
         results = []
         for (d, state, _), msg in zip(channels_msgs, decoded):
+            if msg is None:
+                results.append((state, None))
+                continue
             patch = patches[d] if msg["changes"] else None
             results.append(self._post_receive(d, state, msg, before[d], patch))
         return results

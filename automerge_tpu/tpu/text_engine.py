@@ -26,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..common import parse_op_id
+from ..errors import EncodeError, PackingLimitError
 from .engine import (
     ACTION_DEL,
     ACTION_SET,
@@ -128,7 +129,7 @@ class BatchedTextEngine:
 
     def _grow_elems(self, needed: int):
         if needed > rga.MAX_ELEMS:
-            raise ValueError(
+            raise PackingLimitError(
                 f"text document exceeds {rga.MAX_ELEMS} elements (incl. "
                 "tombstones): beyond the rank kernel's key-packing range"
             )
@@ -160,7 +161,7 @@ class BatchedTextEngine:
             doc_rows = []
             for op, ctr, actor in doc_ops:
                 if ctr >= rga.MAX_COUNTER:
-                    raise ValueError(
+                    raise PackingLimitError(
                         f"op counter {ctr} exceeds the merge-key "
                         "packing range"
                     )
@@ -187,7 +188,7 @@ class BatchedTextEngine:
                     pred = self._pack(op["pred"][0]) if op.get("pred") else -1
                     doc_rows.append((key, packed, ACTION_DEL, 0, pred))
                 else:
-                    raise ValueError(f"Unsupported text op: {op['action']}")
+                    raise EncodeError(f"Unsupported text op: {op['action']}")
             rows.append(doc_rows)
 
         width = max((len(r) for r in rows), default=1) or 1

@@ -30,6 +30,7 @@ from .engine import (
     changes_from_numpy,
 )
 from ..common import parse_op_id
+from ..errors import EncodeError, PackingLimitError
 from ..obs.metrics import get_metrics
 
 _COUNTER_TAG = object()
@@ -91,7 +92,7 @@ class _Interner:
         if idx is None:
             idx = len(self.table)
             if self.max_size is not None and idx >= self.max_size:
-                raise ValueError(
+                raise PackingLimitError(
                     f"{self.name} table overflow: more than {self.max_size} "
                     "distinct entries in batch"
                 )
@@ -118,7 +119,7 @@ class BatchTranscoder:
     def pack_opid_str(self, op_id: str) -> int:
         p = parse_op_id(op_id)
         if p.counter >= _MAX_COUNTER:
-            raise ValueError(
+            raise PackingLimitError(
                 f"op counter {p.counter} exceeds the merge-key packing range"
             )
         return (p.counter << ACTOR_BITS) | self.actors.intern(p.actor_id)
@@ -131,7 +132,7 @@ class BatchTranscoder:
         dense row (slot, op, action, value, pred). Supports set/inc/del on
         maps and table rows, plus makeMap/makeTable child creation."""
         if op_counter >= _MAX_COUNTER:
-            raise ValueError(
+            raise PackingLimitError(
                 f"op counter {op_counter} exceeds the merge-key packing range"
             )
         packed_id = (op_counter << ACTOR_BITS) | self.actors.intern(actor)
@@ -151,7 +152,7 @@ class BatchTranscoder:
             return slot, packed_id, ACTION_INC, int(op["value"]), pred
         if action == "del":
             return slot, packed_id, ACTION_DEL, 0, pred
-        raise ValueError(f"Unsupported op action for the dense engine: {action}")
+        raise EncodeError(f"Unsupported op action for the dense engine: {action}")
 
     def changes_to_batch(self, per_doc_ops, width=None) -> ChangeOpsBatch:
         """`per_doc_ops` is a list (one entry per document) of lists of

@@ -172,6 +172,90 @@ def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
     }
 
 
+def bench_faults(num_docs, rounds, ops_per_round, fault_pct, seed=0):
+    """Degradation curve of the per-doc fault-isolation layer: batch
+    throughput with `fault_pct`% of the docs receiving poisoned deliveries
+    every round (isolation="doc"). Poisoned docs cycle through the byte
+    corpus (truncation, checksum damage, chunk-type rewrite, garbage);
+    healthy-doc throughput is the figure of merit — it measures what the
+    quarantine machinery costs the rest of the batch."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.testing import faults as F
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    buffers = _make_change_stream(rounds, ops_per_round, seed)
+    n_poison = max(0, min(num_docs, round(num_docs * fault_pct / 100)))
+    # spread the poison across the batch (not one contiguous block)
+    stride = max(num_docs // n_poison, 1) if n_poison else 1
+    poisoned = {i * stride for i in range(n_poison)}
+    corrupters = [c for _, c, _ in F.BYTE_CORPUS]
+
+    # quarantine_threshold=None: poisoned docs fail EVERY round instead of
+    # being shed after a streak, so the curve measures sustained isolation
+    # cost, not the (cheaper) shedding steady state.
+    farm = TpuDocFarm(num_docs, capacity=rounds * ops_per_round,
+                      quarantine_threshold=None)
+    warm = TpuDocFarm(num_docs, capacity=rounds * ops_per_round)
+    warm.apply_changes([[buffers[0]]] * num_docs)
+
+    metrics = get_metrics()
+    metrics.reset()
+    quarantined_deliveries = 0
+    start = time.perf_counter()
+    with enabled_metrics():
+        for r, buf in enumerate(buffers):
+            delivery = []
+            for d in range(num_docs):
+                if d in poisoned:
+                    corrupt = corrupters[(d + r) % len(corrupters)]
+                    delivery.append([bytes(corrupt(buf))])
+                else:
+                    delivery.append([buf])
+            result = farm.apply_changes(delivery)
+            quarantined_deliveries += sum(
+                1 for o in result.outcomes if o.status == "quarantined"
+            )
+    elapsed = time.perf_counter() - start
+
+    healthy = num_docs - len(poisoned)
+    snap = metrics.as_dict()
+    causes = {
+        name.split(".")[-1]: entry["value"]
+        for name, entry in snap.items()
+        if name.startswith("farm.quarantine.causes.")
+    }
+    return {
+        "ops_per_sec": healthy * rounds * ops_per_round / elapsed,
+        "elapsed_s": elapsed,
+        "healthy_docs": healthy,
+        "poisoned_docs": len(poisoned),
+        "quarantined_deliveries": quarantined_deliveries,
+        "quarantine_causes": causes,
+    }
+
+
+def _faults_main(fault_pct):
+    """`bench.py --faults N`: healthy-doc throughput with N% poison docs.
+    Runs in-process (the fault path is host-dominated); one JSON line."""
+    num_docs = int(os.environ.get("BENCH_FAULT_DOCS", "512"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "8"))
+    ops_per_round = int(os.environ.get("BENCH_OPS", "64"))
+    clean = bench_faults(num_docs, rounds, ops_per_round, 0)
+    faulted = bench_faults(num_docs, rounds, ops_per_round, fault_pct)
+    print(json.dumps({
+        "metric": "faulted merge throughput (healthy-doc applyChanges ops/sec)",
+        "value": round(faulted["ops_per_sec"]),
+        "unit": "ops/sec",
+        "faults_pct": fault_pct,
+        "vs_clean": round(faulted["ops_per_sec"] / clean["ops_per_sec"], 3)
+        if clean["ops_per_sec"] else 0,
+        "healthy_docs": faulted["healthy_docs"],
+        "poisoned_docs": faulted["poisoned_docs"],
+        "quarantined_deliveries": faulted["quarantined_deliveries"],
+        "quarantine_causes": faulted["quarantine_causes"],
+    }))
+
+
 def bench_python(num_docs, rounds, ops_per_round, seed=0):
     """Sequential reference-parity engine on the same per-doc workload shape
     (measured on a small sample, reported per-op)."""
@@ -333,5 +417,9 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--faults" in sys.argv:
+        arg_index = sys.argv.index("--faults") + 1
+        pct = float(sys.argv[arg_index]) if arg_index < len(sys.argv) else 10.0
+        _faults_main(pct)
     else:
         main()

@@ -1,0 +1,14 @@
+"""AM401 clean fixture: data-plane raises use the taxonomy."""
+# amlint: error-taxonomy
+from automerge_tpu.errors import CausalityError, DecodeError
+
+
+def decode_header(buf):
+    if not buf:
+        raise DecodeError("empty buffer")
+    return buf[0]
+
+
+def gate(seq, expected):
+    if seq < expected:
+        raise CausalityError(f"Reuse of sequence number {seq}")

@@ -1,0 +1,7 @@
+"""AM401 suppressed fixture: a deliberate bare raise with justification."""
+# amlint: error-taxonomy
+
+
+def check_args(hashes):
+    if not isinstance(hashes, list):
+        raise TypeError("hashes must be a list")  # amlint: disable=AM401 — argument-type validation

@@ -1,0 +1,10 @@
+"""AM401 violating fixture: bare stdlib raises on the data plane."""
+# amlint: error-taxonomy
+
+
+def decode_header(buf):
+    if not buf:
+        raise ValueError("empty buffer")
+    if not isinstance(buf, bytes):
+        raise TypeError("not bytes")
+    return buf[0]

@@ -287,8 +287,17 @@ class TestFarmBasics:
             {"action": "set", "obj": "_root", "key": "y",
              "datatype": "uint", "value": 2, "pred": []}])
         farm.apply_changes([[buf1]])
+        # the all-or-nothing escape hatch raises straight out of the call
         with pytest.raises(ValueError, match="sequence number"):
-            farm.apply_changes([[buf1b]])
+            farm.apply_changes([[buf1b]], isolation="batch")
+        # default per-doc isolation captures the same taxonomy error in the
+        # outcome report instead (state untouched)
+        result = farm.apply_changes([[buf1b]])
+        outcome = result.outcomes[0]
+        assert outcome.status == "quarantined"
+        assert isinstance(outcome.error, ValueError)
+        assert "sequence number" in str(outcome.error)
+        assert len(farm.get_all_changes(0)) == 1
 
 
 class TestFarmDifferential:

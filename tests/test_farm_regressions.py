@@ -55,7 +55,11 @@ def test_map_op_counter_beyond_packing_range_rejected():
         [{"action": "set", "obj": "_root", "key": "b", "value": 2, "pred": []}],
     )
     with pytest.raises(ValueError, match="packing range"):
-        farm.apply_changes([[big]])
+        farm.apply_changes([[big]], isolation="batch")
+    # default per-doc isolation quarantines the delivery instead of raising
+    result = farm.apply_changes([[big]])
+    assert result.outcomes[0].status == "quarantined"
+    assert result.outcomes[0].error_kind == "packing"
     # nothing committed: the doc still has exactly one applied change
     assert len(farm.get_all_changes(0)) == 1
     patch = farm.get_patch(0)
@@ -105,7 +109,9 @@ def test_queued_inserts_count_toward_elem_budget(monkeypatch):
     # rejected up front: the queued 2 could become ready in the same call
     buf3, _ = make_change("aaaaaaaa", 2, 2, [h1], _insert_ops(3))
     with pytest.raises(ValueError, match="list elements"):
-        farm.apply_changes([[buf3]])
+        farm.apply_changes([[buf3]], isolation="batch")
+    result = farm.apply_changes([[buf3]])  # per-doc isolation: quarantined
+    assert result.outcomes[0].status == "quarantined"
     assert len(farm.get_all_changes(0)) == 1  # nothing committed
 
 

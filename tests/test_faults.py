@@ -1,0 +1,617 @@
+"""Fault-isolation suite: the farm, sync layer and codecs under poisoned
+traffic (automerge_tpu/testing/faults.py is the corpus + injection harness).
+
+The contract under test (ISSUE 3):
+- one poisoned document must not fail its batch neighbours
+  (isolation="doc", the default) — per-sequence isolation as in batched
+  TPU serving;
+- a quarantined delivery leaves the target document's state byte-for-byte
+  untouched (save/load round-trip, heads, and a subsequent clean apply all
+  match a farm that never saw the poison);
+- the batched device path failing mid-dispatch degrades to the sequential
+  reference walk instead of failing the call;
+- sync peers survive malformed messages with local state untouched.
+"""
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import sync as Sync
+from automerge_tpu.errors import (
+    AutomergeError,
+    CausalityError,
+    ChecksumError,
+    DecodeError,
+    DeviceFaultError,
+    EncodeError,
+    PackingLimitError,
+    QuarantinedError,
+    SyncProtocolError,
+    error_kind,
+)
+from automerge_tpu.columnar import decode_change, decode_change_columns
+from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+from automerge_tpu.opset import OpSet
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu import rga
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+
+def healthy_change(actor, seq, start_op, deps=(), key="k", value=1):
+    return faults.make_change(actor, seq, start_op, deps,
+                              [faults.set_op(key, value)])
+
+
+def change_hash(buf):
+    return decode_change_columns(buf)["hash"]
+
+
+# ---------------------------------------------------------------------- #
+# taxonomy
+
+
+class TestTaxonomy:
+    def test_hierarchy_keeps_stdlib_bases(self):
+        # existing callers catch ValueError; the taxonomy must not break them
+        for cls in (DecodeError, ChecksumError, EncodeError, CausalityError,
+                    PackingLimitError, SyncProtocolError):
+            assert issubclass(cls, AutomergeError)
+            assert issubclass(cls, ValueError)
+        assert issubclass(ChecksumError, DecodeError)
+        assert issubclass(QuarantinedError, AutomergeError)
+        assert issubclass(DeviceFaultError, AutomergeError)
+
+    def test_error_kind_dimension(self):
+        assert error_kind(DecodeError("x")) == "decode"
+        assert error_kind(ChecksumError("x")) == "checksum"
+        assert error_kind(CausalityError("x")) == "causality"
+        assert error_kind(PackingLimitError("x")) == "packing"
+        assert error_kind(SyncProtocolError("x")) == "sync"
+        assert error_kind(DeviceFaultError("x")) == "device"
+        assert error_kind(ValueError("x")) == "other"
+        assert error_kind(RuntimeError("x")) == "other"
+
+
+# ---------------------------------------------------------------------- #
+# corrupters
+
+
+class TestCorrupters:
+    @pytest.mark.parametrize("name,corrupt,kind", faults.BYTE_CORPUS,
+                             ids=[c[0] for c in faults.BYTE_CORPUS])
+    def test_byte_corpus_error_kinds(self, name, corrupt, kind):
+        buf = healthy_change("aaaaaaaa", 1, 1)
+        poisoned = corrupt(buf)
+        assert poisoned != buf
+        with pytest.raises(DecodeError) as exc_info:
+            decode_change(poisoned)
+        assert error_kind(exc_info.value) == kind
+
+    def test_bad_chunk_type_preserves_checksum(self):
+        """The chunk-type rewrite is a checksum-preserving field mutation:
+        the container verifies, the *content* is wrong."""
+        buf = faults.bad_chunk_type(healthy_change("aaaaaaaa", 1, 1))
+        with pytest.raises(DecodeError, match="chunk type"):
+            decode_change(buf)
+
+    def test_seq_poisons_raise_causality(self):
+        opset = OpSet()
+        opset.apply_changes([healthy_change("aaaaaaaa", 1, 1)])
+        with pytest.raises(CausalityError, match="Reuse of sequence number"):
+            opset.apply_changes([faults.seq_reused("aaaaaaaa", 1, 2)])
+        with pytest.raises(CausalityError, match="Skipped sequence number"):
+            opset.apply_changes([faults.seq_skipped("aaaaaaaa", 5, 2)])
+
+    def test_missing_dep_queues_forever_without_error(self):
+        opset = OpSet()
+        patch = opset.apply_changes([faults.missing_dep("bbbbbbbb", 1, 1)])
+        assert patch["pendingChanges"] == 1
+        assert opset.get_missing_deps() == [faults.MISSING_DEP]
+
+
+# ---------------------------------------------------------------------- #
+# the acceptance batch: 64 docs, 8 poisoned, one call
+
+
+class TestFarmIsolation:
+    N = 64
+
+    def _setup_farms(self, monkeypatch, threshold=None):
+        monkeypatch.setattr(rga, "MAX_ELEMS", 4)
+        farm = TpuDocFarm(self.N, capacity=64, quarantine_threshold=threshold)
+        control = TpuDocFarm(self.N, capacity=64, quarantine_threshold=threshold)
+        seeds = [healthy_change(f"{d:08x}", 1, 1, value=d) for d in range(self.N)]
+        farm.apply_changes([[b] for b in seeds])
+        control.apply_changes([[b] for b in seeds])
+        heads = [farm.get_heads(d) for d in range(self.N)]
+        return farm, control, seeds, heads
+
+    def _poison_delivery(self, heads):
+        """Second-round delivery: 8 poisoned docs spanning every taxonomy
+        bucket, 56 healthy. Returns (delivery, poison: doc -> expected)."""
+        delivery = []
+        poison = {
+            1: ChecksumError, 9: ChecksumError,     # corrupt checksum
+            17: DecodeError, 25: DecodeError,       # truncated buffer
+            33: CausalityError,                     # seq reuse
+            41: PackingLimitError, 49: PackingLimitError,  # counter overflow
+            57: PackingLimitError,                  # MAX_ELEMS overflow
+        }
+        for d in range(self.N):
+            actor = f"{d:08x}"
+            good = healthy_change(actor, 2, 2, heads[d], key="r2", value=d)
+            if d in (1, 9):
+                delivery.append([faults.corrupt_checksum(good)])
+            elif d in (17, 25):
+                delivery.append([faults.truncated(good)])
+            elif d == 33:
+                delivery.append([faults.seq_reused(actor, 1, 2, heads[d])])
+            elif d in (41, 49):
+                delivery.append([faults.counter_overflow(
+                    actor, 2, rga.MAX_COUNTER, heads[d])])
+            elif d == 57:
+                make_list = faults.make_change(
+                    actor, 2, 2, heads[d],
+                    [{"action": "makeList", "obj": "_root", "key": "l",
+                      "pred": []}])
+                flood = faults.insert_flood(
+                    actor, 3, 3, f"2@{actor}", rga.MAX_ELEMS + 1,
+                    [change_hash(make_list)])
+                delivery.append([make_list, flood])
+            else:
+                delivery.append([good])
+        return delivery, poison
+
+    def test_64_doc_batch_with_8_poisoned(self, monkeypatch):
+        farm, control, _seeds, heads = self._setup_farms(monkeypatch)
+        delivery, poison = self._poison_delivery(heads)
+
+        result = farm.apply_changes(delivery)
+
+        # the 56 healthy docs all applied, byte-equal to a farm that never
+        # saw the poison
+        control_delivery = [
+            [] if d in poison else delivery[d] for d in range(self.N)
+        ]
+        expected = control.apply_changes(control_delivery)
+        for d in range(self.N):
+            if d in poison:
+                continue
+            assert result.outcomes[d].status == "applied"
+            assert result[d] == expected[d]
+
+        # quarantined docs report the right taxonomy error, state untouched
+        assert set(result.quarantined) == set(poison)
+        for d, expected_cls in poison.items():
+            outcome = result.outcomes[d]
+            assert outcome.status == "quarantined"
+            assert isinstance(outcome.error, expected_cls), (d, outcome.error)
+            assert outcome.error_kind == error_kind(outcome.error)
+            assert len(farm.get_all_changes(d)) == 1  # only the seed
+            assert farm.get_heads(d) == heads[d]
+            assert farm.get_patch(d) == control.get_patch(d)
+
+        # packing/causality poisons carry the offending change hashes
+        assert result.outcomes[33].offending_hashes
+        assert result.outcomes[41].offending_hashes
+
+    def test_batch_isolation_reproduces_all_or_nothing(self, monkeypatch):
+        farm, _control, _seeds, heads = self._setup_farms(monkeypatch)
+        delivery, _poison = self._poison_delivery(heads)
+        committed = [len(farm.get_all_changes(d)) for d in range(self.N)]
+        with pytest.raises(ValueError):
+            farm.apply_changes(delivery, isolation="batch")
+        # the decode-phase poison aborts the call before anything commits
+        assert [len(farm.get_all_changes(d)) for d in range(self.N)] == committed
+
+    def test_unknown_isolation_mode_rejected(self):
+        farm = TpuDocFarm(1)
+        with pytest.raises(ValueError, match="isolation"):
+            farm.apply_changes([[]], isolation="nope")
+
+    def test_quarantine_cause_counters(self, monkeypatch):
+        reg = get_metrics()
+        reg.reset()
+        with enabled_metrics():
+            farm, _control, _seeds, heads = self._setup_farms(monkeypatch)
+            delivery, _poison = self._poison_delivery(heads)
+            farm.apply_changes(delivery)
+        snap = reg.as_dict()
+        assert snap["farm.quarantine.causes.checksum"]["value"] == 2
+        assert snap["farm.quarantine.causes.decode"]["value"] == 2
+        assert snap["farm.quarantine.causes.causality"]["value"] == 1
+        assert snap["farm.quarantine.causes.packing"]["value"] == 3
+        # the batch-wide abort counter stays untouched in doc mode
+        assert snap["farm.prevalidation.aborts"]["value"] == 0
+
+
+# ---------------------------------------------------------------------- #
+# error-path state invariance (property-style over the fault corpus)
+
+
+def _fault_corpus_for(actor, seq, start_op, deps):
+    """Poisoned second-round deliveries for one doc, spanning the corpus."""
+    good = faults.make_change(actor, seq, start_op, deps,
+                              [faults.set_op("r2", 7)])
+    return [
+        ("truncated", [faults.truncated(good)]),
+        ("bit_flipped", [faults.bit_flipped(good, bit=13)]),
+        ("corrupt_checksum", [faults.corrupt_checksum(good)]),
+        ("bad_chunk_type", [faults.bad_chunk_type(good)]),
+        ("garbage", [faults.garbage(40, seed=3)]),
+        ("seq_reuse", [faults.seq_reused(actor, seq - 1, start_op, deps)]),
+        ("seq_skip", [faults.seq_skipped(actor, seq + 5, start_op, deps)]),
+        ("counter_overflow",
+         [faults.counter_overflow(actor, seq, rga.MAX_COUNTER, deps)]),
+        ("mixed_good_then_poison",
+         [good, faults.corrupt_checksum(
+             faults.make_change(actor, seq + 1, start_op + 1,
+                                [change_hash(good)],
+                                [faults.set_op("r3", 8)]))]),
+    ]
+
+
+class TestStateInvariance:
+    def test_quarantine_leaves_state_equal_to_never_poisoned(self):
+        """After ANY quarantined delivery: save()/load() round-trip,
+        get_heads, and a subsequent clean apply on the same doc must match
+        a farm that never saw the poison."""
+        seed = healthy_change("bbbbbbbb", 1, 1, value=3)
+        seed_hash = change_hash(seed)
+        for name, poisoned in _fault_corpus_for("bbbbbbbb", 2, 2, [seed_hash]):
+            farm = TpuDocFarm(2, capacity=32)
+            control = TpuDocFarm(2, capacity=32)
+            neighbour = healthy_change("aaaaaaaa", 1, 1, value=9)
+            for f in (farm, control):
+                f.apply_changes([[neighbour], [seed]])
+
+            result = farm.apply_changes([[], poisoned])
+            assert result.outcomes[1].status == "quarantined", name
+            assert result.outcomes[0].status == "applied", name
+
+            # state untouched: heads + committed log match the control
+            assert farm.get_heads(1) == control.get_heads(1), name
+            assert farm.get_all_changes(1) == control.get_all_changes(1), name
+
+            # save/load round-trip through the binary document format
+            replica = OpSet()
+            replica.apply_changes(farm.get_all_changes(1))
+            reloaded = OpSet(replica.save())
+            assert reloaded.heads == farm.get_heads(1), name
+            assert reloaded.get_patch() == control.get_patch(1), name
+
+            # a subsequent clean apply behaves as if the poison never came
+            clean = healthy_change("bbbbbbbb", 2, 2, [seed_hash],
+                                   key="after", value=11)
+            got = farm.apply_changes([[], [clean]])
+            want = control.apply_changes([[], [clean]])
+            assert got[1] == want[1], name
+            assert got.outcomes[1].status == "applied", name
+            assert farm.get_patch(1) == control.get_patch(1), name
+
+    def test_poisoned_list_doc_rolls_back_element_tables(self, monkeypatch):
+        """A rolled-back delivery must also restore the element forest the
+        rank kernel reads (num_elems + index maps)."""
+        monkeypatch.setattr(rga, "MAX_ELEMS", 8)
+        farm = TpuDocFarm(1, capacity=32)
+        control = TpuDocFarm(1, capacity=32)
+        mk = faults.make_change(
+            "aaaaaaaa", 1, 1, [],
+            [{"action": "makeList", "obj": "_root", "key": "l", "pred": []}])
+        ins = faults.insert_flood("aaaaaaaa", 2, 2, "1@aaaaaaaa", 2,
+                                  [change_hash(mk)])
+        for f in (farm, control):
+            f.apply_changes([[mk]])
+            f.apply_changes([[ins]])
+        # a delivery that inserts 3 then overflows: rolled back atomically
+        deps = farm.get_heads(0)
+        more = faults.insert_flood("aaaaaaaa", 3, 4, "1@aaaaaaaa", 3, deps)
+        flood = faults.insert_flood("aaaaaaaa", 4, 7, "1@aaaaaaaa", 20,
+                                    [change_hash(more)])
+        result = farm.apply_changes([[more, flood]])
+        assert result.outcomes[0].status == "quarantined"
+        assert result.outcomes[0].error_kind == "packing"
+        assert int(farm.num_elems[0]) == int(control.num_elems[0]) == 2
+        # the clean prefix alone still applies afterwards
+        got = farm.apply_changes([[more]])
+        want = control.apply_changes([[more]])
+        assert got[0] == want[0]
+        assert int(farm.num_elems[0]) == 5
+
+
+# ---------------------------------------------------------------------- #
+# quarantine lifecycle
+
+
+class TestQuarantineLifecycle:
+    def test_threshold_shedding_and_release(self):
+        reg = get_metrics()
+        reg.reset()
+        with enabled_metrics():
+            farm = TpuDocFarm(2, capacity=32, quarantine_threshold=2)
+            good = healthy_change("aaaaaaaa", 1, 1)
+            bad = faults.garbage(32)
+            # two consecutive failures cross the threshold
+            assert farm.apply_changes([[bad], []]).outcomes[0].status == "quarantined"
+            assert 0 not in farm.quarantine
+            assert farm.apply_changes([[bad], []]).outcomes[0].status == "quarantined"
+            assert 0 in farm.quarantine
+
+            # traffic is shed unprocessed — even healthy deliveries
+            shed = farm.apply_changes([[good], []])
+            assert isinstance(shed.outcomes[0].error, QuarantinedError)
+            assert len(farm.get_all_changes(0)) == 0
+            # the neighbour is unaffected throughout
+            ok = farm.apply_changes([[], [good]])
+            assert ok.outcomes[1].status == "applied"
+
+            assert farm.release_quarantine(0) == [0]
+            back = farm.apply_changes([[good], []])
+            assert back.outcomes[0].status == "applied"
+            assert len(farm.get_all_changes(0)) == 1
+        snap = reg.as_dict()
+        assert snap["farm.quarantine.entered"]["value"] == 1
+        assert snap["farm.quarantine.shed"]["value"] == 1
+        assert snap["farm.quarantine.released"]["value"] == 1
+        assert snap["farm.quarantine.active"]["value"] == 0
+
+    def test_clean_delivery_resets_failure_streak(self):
+        farm = TpuDocFarm(1, capacity=32, quarantine_threshold=2)
+        bad = faults.garbage(32)
+        farm.apply_changes([[bad]])
+        assert farm.fault_counts[0] == 1
+        farm.apply_changes([[healthy_change("aaaaaaaa", 1, 1)]])
+        assert farm.fault_counts[0] == 0
+        farm.apply_changes([[bad]])
+        assert 0 not in farm.quarantine  # streak restarted
+
+    def test_release_all(self):
+        farm = TpuDocFarm(3, capacity=32, quarantine_threshold=1)
+        bad = faults.garbage(32)
+        farm.apply_changes([[bad], [], [bad]])
+        assert set(farm.quarantine) == {0, 2}
+        assert sorted(farm.release_quarantine()) == [0, 2]
+        assert farm.quarantine == {}
+
+
+# ---------------------------------------------------------------------- #
+# degraded mode: device-dispatch bisection + sequential fallback
+
+
+class TestDeviceFallback:
+    def _seeded(self, n=8):
+        farm = TpuDocFarm(n, capacity=64, quarantine_threshold=None)
+        control = TpuDocFarm(n, capacity=64, quarantine_threshold=None)
+        seeds = [healthy_change(f"{d:08x}", 1, 1, value=d) for d in range(n)]
+        farm.apply_changes([[b] for b in seeds])
+        control.apply_changes([[b] for b in seeds])
+        return farm, control, seeds
+
+    def test_bisect_isolates_poison_doc_and_survivors_get_patches(self):
+        reg = get_metrics()
+        reg.reset()
+        farm, control, _ = self._seeded(8)
+        second = [
+            healthy_change(f"{d:08x}", 2, 2, farm.get_heads(d), key="r2",
+                           value=d * 10)
+            for d in range(8)
+        ]
+        with enabled_metrics():
+            with faults.inject("farm.device_dispatch", faults.fail_docs([3])):
+                result = farm.apply_changes([[b] for b in second])
+
+        assert result.outcomes[3].status == "quarantined"
+        assert isinstance(result.outcomes[3].error, DeviceFaultError)
+        assert result.outcomes[3].error_kind == "device"
+        assert len(farm.get_all_changes(3)) == 1  # rolled back
+
+        # survivors applied via the sequential walk, patches reference-equal
+        expected = control.apply_changes(
+            [[] if d == 3 else [second[d]] for d in range(8)]
+        )
+        for d in range(8):
+            if d == 3:
+                continue
+            assert result.outcomes[d].status == "applied"
+            assert result.outcomes[d].fallback
+            assert result[d] == expected[d]
+
+        snap = reg.as_dict()
+        assert snap["farm.bisect.rounds"]["value"] > 0
+        assert snap["farm.fallback.calls"]["value"] == 1
+        assert snap["farm.fallback.docs"]["value"] == 7
+        assert snap["farm.quarantine.causes.device"]["value"] == 1
+
+    def test_degraded_docs_keep_working_after_fallback(self):
+        farm, control, _ = self._seeded(4)
+        second = [healthy_change(f"{d:08x}", 2, 2, farm.get_heads(d), key="r2")
+                  for d in range(4)]
+        with faults.inject("farm.device_dispatch", faults.fail_docs([2])):
+            farm.apply_changes([[b] for b in second])
+        control.apply_changes([[] if d == 2 else [second[d]] for d in range(4)])
+
+        # next call has a healthy device again; degraded docs stay walk-served
+        third = [healthy_change(f"{d:08x}", 3, 3, farm.get_heads(d), key="r3")
+                 for d in range(4)]
+        third[2] = healthy_change("00000002", 2, 2, farm.get_heads(2), key="r2")
+        got = farm.apply_changes([[b] for b in third])
+        want = control.apply_changes([[b] for b in third])
+        for d in range(4):
+            assert got.outcomes[d].status == "applied"
+            assert got[d] == want[d]
+            assert farm.get_patch(d) == control.get_patch(d)
+
+    def test_wedged_device_serves_whole_batch_sequentially(self):
+        farm, control, _ = self._seeded(4)
+        second = [healthy_change(f"{d:08x}", 2, 2, farm.get_heads(d), key="r2")
+                  for d in range(4)]
+        with faults.inject("farm.device_dispatch", faults.fail_always()):
+            result = farm.apply_changes([[b] for b in second])
+        expected = control.apply_changes([[b] for b in second])
+        for d in range(4):
+            assert result.outcomes[d].status == "applied"
+            assert result.outcomes[d].fallback
+            assert result[d] == expected[d]
+
+
+# ---------------------------------------------------------------------- #
+# injection points in engine + opset atomicity
+
+
+class TestInjectionPoints:
+    def test_engine_apply_batch_point_fires(self):
+        from automerge_tpu.tpu.engine import BatchedMapEngine
+        from automerge_tpu.tpu.transcode import BatchTranscoder
+
+        engine = BatchedMapEngine(1, 8)
+        tr = BatchTranscoder()
+        batch = tr.changes_to_batch(
+            [[({"action": "set", "obj": "_root", "key": "k", "value": 1,
+                "pred": []}, 1, "aaaaaaaa")]]
+        )
+        with faults.inject("engine.apply_batch", faults.fail_always()):
+            with pytest.raises(RuntimeError, match="injected"):
+                engine.apply_batch(batch)
+        engine.apply_batch(batch)  # hook removed on exit
+
+    def test_inject_is_scoped(self):
+        fired = []
+        with faults.inject("sync.receive_message", lambda **kw: fired.append(1)):
+            assert "sync.receive_message" in faults._HOOKS
+        assert "sync.receive_message" not in faults._HOOKS
+
+    def test_opset_apply_is_atomic_on_gate_failure(self):
+        """A mixed delivery that raises must leave no phantom hash-index
+        entries behind (the sync layer's state-untouched guarantee rests
+        on this)."""
+        opset = OpSet()
+        opset.apply_changes([healthy_change("aaaaaaaa", 1, 1)])
+        good = healthy_change("aaaaaaaa", 2, 2, opset.heads)
+        poison = faults.seq_reused("aaaaaaaa", 1, 3, [change_hash(good)])
+        before_index = dict(opset.change_index_by_hash)
+        before_heads = list(opset.heads)
+        with pytest.raises(CausalityError):
+            opset.apply_changes([good, poison])
+        assert opset.change_index_by_hash == before_index
+        assert opset.heads == before_heads
+        # the clean prefix still applies on retry
+        patch = opset.apply_changes([good])
+        assert patch["clock"]["aaaaaaaa"] == 2
+
+
+# ---------------------------------------------------------------------- #
+# sync-layer survival
+
+
+class TestSyncFaults:
+    def _two_peers(self):
+        a = Backend.init()
+        a, _ = Backend.apply_changes(a, [healthy_change("aaaaaaaa", 1, 1)])
+        return a, Sync.init_sync_state()
+
+    def test_malformed_message_rejected_state_untouched(self):
+        backend, state = self._two_peers()
+        heads = Backend.get_heads(backend)
+        valid = Sync.encode_sync_message(
+            {"heads": heads, "need": [], "have": [], "changes": []}
+        )
+        for bad in (faults.truncated(valid, keep=3), b"\x00" + valid[1:],
+                    faults.garbage(16)):
+            with pytest.raises(SyncProtocolError):
+                Sync.receive_sync_message(backend, state, bad)
+            # the handle is still usable (not frozen) and state unchanged
+            assert Backend.get_heads(backend) == heads
+            assert state["theirHeads"] is None
+        # and the same message minus corruption still processes
+        backend, state, _ = Sync.receive_sync_message(backend, state, valid)
+        assert state["theirHeads"] == heads
+
+    def test_message_with_poisoned_changes_rejected(self):
+        backend, state = self._two_peers()
+        heads = Backend.get_heads(backend)
+        poison = faults.seq_reused("aaaaaaaa", 1, 2, heads)
+        msg = Sync.encode_sync_message(
+            {"heads": heads, "need": [], "have": [], "changes": [poison]}
+        )
+        with pytest.raises(SyncProtocolError, match="inapplicable"):
+            Sync.receive_sync_message(backend, state, msg)
+        assert Backend.get_heads(backend) == heads
+        # backend still usable for a clean message afterwards
+        clean = healthy_change("bbbbbbbb", 1, 2, key="other")
+        msg2 = Sync.encode_sync_message(
+            {"heads": heads, "need": [], "have": [], "changes": [clean]}
+        )
+        backend, state, patch = Sync.receive_sync_message(backend, state, msg2)
+        assert patch is not None
+
+    def test_rejected_counter_increments(self):
+        reg = get_metrics()
+        reg.reset()
+        backend, state = self._two_peers()
+        with enabled_metrics():
+            with pytest.raises(SyncProtocolError):
+                Sync.receive_sync_message(backend, state, faults.garbage(16))
+        assert reg.counter("sync.messages.rejected").value == 1
+
+    def test_injection_point_rejects_like_a_wire_fault(self):
+        backend, state = self._two_peers()
+        valid = Sync.encode_sync_message(
+            {"heads": Backend.get_heads(backend), "need": [], "have": [],
+             "changes": []}
+        )
+        with faults.inject(
+            "sync.receive_message",
+            faults.fail_always(lambda: ValueError("line noise")),
+        ):
+            with pytest.raises(SyncProtocolError):
+                Sync.receive_sync_message(backend, state, valid)
+
+    def test_sync_farm_survives_one_bad_peer(self):
+        from automerge_tpu.tpu.sync_farm import SyncFarm
+
+        farm = TpuDocFarm(3, capacity=32)
+        farm.apply_changes(
+            [[healthy_change(f"{d:08x}", 1, 1, value=d)] for d in range(3)]
+        )
+        sf = SyncFarm(farm)
+        heads = [farm.get_heads(d) for d in range(3)]
+
+        def msg_for(d, changes=()):
+            return Sync.encode_sync_message(
+                {"heads": heads[d], "need": [], "have": [],
+                 "changes": list(changes)}
+            )
+
+        good0 = msg_for(0)
+        bad1 = faults.truncated(msg_for(1), keep=3)
+        new2 = healthy_change("00000002", 2, 2, heads[2], key="r2")
+        good2 = msg_for(2, [new2])
+        states = [SyncFarm.init_state() for _ in range(3)]
+        results = sf.receive_messages([
+            (0, states[0], good0), (1, states[1], bad1), (2, states[2], good2),
+        ])
+        # bad channel: state unchanged, no patch, round not aborted
+        assert results[1] == (states[1], None)
+        assert results[0][0]["theirHeads"] == heads[0]
+        assert results[2][1] is not None  # the healthy channel's patch
+        assert len(farm.get_all_changes(2)) == 2
+
+    def test_peers_converge_after_poisoned_interlude(self):
+        """End-to-end: two api-level peers keep syncing to convergence even
+        though one receives corrupt messages mid-conversation."""
+        a = am.change(am.init("aaaaaaaa"), lambda d: d.__setitem__("x", 1))
+        b = am.change(am.init("bbbbbbbb"), lambda d: d.__setitem__("y", 2))
+        sa, sb = am.init_sync_state(), am.init_sync_state()
+        for _ in range(10):
+            sa, msg_ab = am.generate_sync_message(a, sa)
+            sb, msg_ba = am.generate_sync_message(b, sb)
+            if msg_ab is None and msg_ba is None:
+                break
+            if msg_ab is not None:
+                # b sees a corrupted copy first, rejects it, then the real one
+                with pytest.raises(SyncProtocolError):
+                    am.receive_sync_message(b, sb, faults.truncated(msg_ab, keep=5))
+                b, sb, _ = am.receive_sync_message(b, sb, msg_ab)
+            if msg_ba is not None:
+                a, sa, _ = am.receive_sync_message(a, sa, msg_ba)
+        assert dict(a) == dict(b) == {"x": 1, "y": 2}

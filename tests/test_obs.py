@@ -392,7 +392,12 @@ def test_gate_deferral_and_prevalidation_abort_metrics():
                                  "datatype": "uint", "value": 1, "pred": []}],
         })
         with pytest.raises(ValueError):
-            farm.apply_changes([[big]])
+            farm.apply_changes([[big]], isolation="batch")
+        assert reg.counter("farm.prevalidation.aborts").value == 1
+        # per-doc isolation routes the same failure through the
+        # error_kind-dimensioned quarantine cause family instead
+        farm.apply_changes([[big]])
+        assert reg.counter("farm.quarantine.causes.packing").value == 1
         assert reg.counter("farm.prevalidation.aborts").value == 1
 
 
