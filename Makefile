@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults
+.PHONY: lint test native obs-report faults bench-smoke
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -17,6 +17,12 @@ test:
 # curve with N% poison docs: `python bench.py --faults N`.
 faults:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
+
+# host perf gate: fails when the visibility+patch_assembly share of
+# end-to-end time regresses above BENCH_SMOKE_MAX_TAIL_SHARE (README
+# "Performance"); also runs as a tier-1 test (tests/test_bench_smoke.py)
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --quick
 
 native:
 	$(MAKE) -C native

@@ -19,9 +19,11 @@ CLI::
 
 Rule families (see core.RULES for the catalog):
 
-- **AM1xx packing**: bit-layout constant consistency (AM101), magic
-  shift/mask literals (AM102), interner caps (AM103), packing-limit
-  diagnostic wording (AM104).
+- **AM1xx packing/hotpath**: bit-layout constant consistency (AM101),
+  magic shift/mask literals (AM102), interner caps (AM103), packing-limit
+  diagnostic wording (AM104), per-row Python (``sort(key=lambda)``,
+  range-loop ``int()``/``bool()`` coercion) in profiled hot-phase modules
+  (AM105).
 - **AM2xx tracer safety**: Python control flow on traced values (AM201),
   host calls on traced values (AM202), dtype-less array construction
   (AM203), captured-state mutation in traced code (AM204).
@@ -42,7 +44,7 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import boundary, obsrules, packing, taxonomy, tracer
+from . import boundary, hotpath, obsrules, packing, taxonomy, tracer
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -74,7 +76,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
         except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
-    for family in (packing, tracer, boundary, obsrules, taxonomy):
+    for family in (packing, tracer, boundary, obsrules, taxonomy, hotpath):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

@@ -37,6 +37,10 @@ RULES: dict[str, tuple[str, str]] = {
     "AM103": ("packing", "_Interner constructed without a max_size packing cap"),
     "AM104": ("packing", "packing-limit diagnostic names the wrong range "
                          "(merge-key vs rank-kernel)"),
+    "AM105": ("hotpath", "per-row Python in a profiled hot phase: "
+                         "sort(key=lambda ...) or int()/bool() coercion "
+                         "over range-indexed rows (use column ops and a "
+                         "precomputed sort-key column)"),
     "AM201": ("tracer", "Python-level control flow on a traced value inside "
                         "jit/pallas-traced code"),
     "AM202": ("tracer", "host-side call (np.*, int()/float(), .item()) on a "
@@ -61,6 +65,7 @@ _SUPPRESS_RE = re.compile(
     r"#\s*amlint:\s*(disable|disable-file)\s*=\s*([A-Z0-9,\s]+)"
 )
 _HOST_ONLY_RE = re.compile(r"#\s*amlint:\s*host-only")
+_HOT_PATH_RE = re.compile(r"#\s*amlint:\s*hot-path")
 
 
 @dataclasses.dataclass
@@ -94,6 +99,7 @@ class FileContext:
         self.line_suppress: dict[int, set[str]] = {}
         self.file_suppress: set[str] = set()
         self.host_only_marker = False
+        self.hot_path_marker = False
         self._parse_comments()
 
     # ------------------------------------------------------------------ #
@@ -122,6 +128,8 @@ class FileContext:
         for line, standalone, text in comments:
             if _HOST_ONLY_RE.search(text):
                 self.host_only_marker = True
+            if _HOT_PATH_RE.search(text):
+                self.hot_path_marker = True
             m = _SUPPRESS_RE.search(text)
             if not m:
                 continue

@@ -1,0 +1,119 @@
+"""AM105 — hot-phase hygiene: no per-row Python in the farm's profiled
+hot phases.
+
+BENCH_r05 showed the merge farm spending >85% of wall time in host-side
+Python that re-walks state row by row (``visibility`` + ``patch_assembly``
++ ``decode``). The fix was structural — column masks, batched
+searchsorted, precomputed sort-key columns — and this rule keeps the
+anti-patterns from creeping back into the modules that implement the
+profiled phases:
+
+- ``xs.sort(key=lambda ...)`` / ``sorted(xs, key=lambda ...)``: a Python
+  callback per element where a precomputed, vectorisable sort-key column
+  (e.g. transcode.lamport_keys) does the same work in one argsort;
+- ``int(...)`` / ``bool(...)`` coercion of subscripted values inside a
+  ``for``/comprehension over ``range(...)``: the classic row-at-a-time
+  scan over a dense array, where a boolean mask or column gather should
+  run first so per-row Python only touches rows that survive the filter.
+
+Scope: modules whose filename stem is in ``HOT_PHASE_STEMS`` (the farm's
+assembly layers), plus any file carrying a ``# amlint: hot-path`` marker.
+Deliberately-cold call sites inside a hot module (per-call table builds,
+debug paths) carry justified ``# amlint: disable=AM105`` suppressions.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .core import FileContext, Finding, dotted_name
+
+#: modules implementing the profiled hot phases (gate+transcode, pack,
+#: visibility, patch_assembly)
+HOT_PHASE_STEMS = frozenset({"farm", "transcode"})
+
+_COERCIONS = {"int", "bool"}
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return Path(ctx.path).stem in HOT_PHASE_STEMS or ctx.hot_path_marker
+
+
+def _is_key_lambda_sort(node: ast.Call) -> str | None:
+    """'sort'/'sorted' when the call passes key=lambda, else None."""
+    name = None
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "sort":
+        name = ".sort"
+    else:
+        fname = dotted_name(node.func)
+        if fname == "sorted":
+            name = "sorted"
+    if name is None:
+        return None
+    for kw in node.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Lambda):
+            return name
+    return None
+
+
+def _is_range_loop(iter_node: ast.expr) -> bool:
+    return (
+        isinstance(iter_node, ast.Call)
+        and isinstance(iter_node.func, ast.Name)
+        and iter_node.func.id == "range"
+    )
+
+
+def _coercion_of_subscript(node: ast.Call) -> bool:
+    if not (
+        isinstance(node.func, ast.Name)
+        and node.func.id in _COERCIONS
+        and len(node.args) == 1
+    ):
+        return False
+    return any(isinstance(sub, ast.Subscript) for sub in ast.walk(node.args[0]))
+
+
+def _range_loop_bodies(tree: ast.Module):
+    """Yields (report_node, body_nodes) for every range()-driven loop:
+    ``for i in range(...)`` statements and range()-driven comprehensions."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and _is_range_loop(node.iter):
+            yield node, node.body
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            if any(_is_range_loop(gen.iter) for gen in node.generators):
+                if isinstance(node, ast.DictComp):
+                    yield node, [node.key, node.value]
+                else:
+                    yield node, [node.elt]
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        if not _in_scope(ctx):
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                spelling = _is_key_lambda_sort(node)
+                if spelling is not None:
+                    findings.append(ctx.finding(
+                        "AM105", node,
+                        f"`{spelling}(key=lambda ...)` in a hot-phase "
+                        "module: a Python callback runs per element — "
+                        "precompute a vectorisable sort-key column (e.g. "
+                        "transcode.lamport_keys) and argsort it",
+                    ))
+        for loop, body in _range_loop_bodies(ctx.tree):
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and _coercion_of_subscript(sub):
+                        findings.append(ctx.finding(
+                            "AM105", sub,
+                            "per-row `int()`/`bool()` coercion inside a "
+                            "range()-indexed loop in a hot-phase module: "
+                            "filter with boolean column masks first so "
+                            "per-row Python only touches surviving rows",
+                        ))
+    return findings

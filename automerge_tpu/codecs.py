@@ -17,9 +17,70 @@ bit-identical output for the same value sequences:
 from __future__ import annotations
 
 from .errors import DecodeError, EncodeError
+from .obs.metrics import get_metrics
 
 MAX_SAFE_INTEGER = 2**53 - 1
 MIN_SAFE_INTEGER = -(2**53 - 1)
+
+
+class DecodeCache:
+    """Bounded LRU of decoded artefacts keyed by the raw chunk bytes.
+
+    A change gossiped to N documents, or replayed across sync rounds, is
+    parsed once: the decoded object is cached under the chunk bytes (the
+    change hash is the sha256 of those bytes, so byte-keying IS hash-keying
+    without paying the digest on every lookup). Cached values are shared
+    between callers — treat them as immutable; callers that need to attach
+    per-delivery state must copy (columnar.decode_change_cached returns a
+    shallow copy per hit for exactly that reason).
+
+    Capacity bounds the working set (oldest-used entries evict first).
+    Hits/misses/evictions are counted on the process-wide metrics registry
+    under the instrument names ``<name>.{hits,misses,evictions}``; caches
+    constructed with the same name share one set of instruments.
+    """
+
+    __slots__ = ("capacity", "_entries", "_m_hits", "_m_misses", "_m_evictions")
+
+    def __init__(self, capacity: int, name: str = "codecs.decode_cache"):
+        if capacity <= 0:
+            raise ValueError("DecodeCache capacity must be positive")  # amlint: disable=AM401 — API-usage validation
+        self.capacity = capacity
+        self._entries: dict = {}
+        metrics = get_metrics()
+        self._m_hits = metrics.counter(
+            f"{name}.hits", "decode calls served from the LRU"
+        )
+        self._m_misses = metrics.counter(
+            f"{name}.misses", "decode calls that parsed the bytes"
+        )
+        self._m_evictions = metrics.counter(
+            f"{name}.evictions", "entries dropped by the LRU capacity bound"
+        )
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key):
+        """The cached value for `key` (refreshing its recency), else None."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            self._m_misses.inc()
+            return None
+        self._entries[key] = entry  # dicts iterate in insertion order: re-
+        self._m_hits.inc()          # inserting makes this the newest entry
+        return entry
+
+    def put(self, key, value) -> None:
+        if key in self._entries:
+            self._entries.pop(key)
+        elif len(self._entries) >= self.capacity:
+            self._entries.pop(next(iter(self._entries)))  # oldest entry
+            self._m_evictions.inc()
+        self._entries[key] = value
+
+    def clear(self) -> None:
+        self._entries.clear()
 
 
 def hex_to_bytes(value: str) -> bytes:

@@ -9,6 +9,7 @@ zlib (raw streams).
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from hashlib import sha256
@@ -21,6 +22,7 @@ from .codecs import (
     BooleanDecoder,
     BooleanEncoder,
     Decoder,
+    DecodeCache,
     DeltaDecoder,
     DeltaEncoder,
     Encoder,
@@ -926,6 +928,55 @@ def decode_change(buffer):
     del change["actorIds"]
     del change["columns"]
     return change
+
+
+# ---------------------------------------------------------------------- #
+# decode memoization: a change gossiped to N documents (the farm fans one
+# delivery across a batch) or replayed across sync rounds (sync peers re-
+# derive metadata for every candidate every round) is parsed ONCE. Keyed by
+# the raw chunk bytes — the change hash is sha256 over those bytes, so the
+# key identifies the change exactly. Both caches share one metric family:
+# codecs.decode_cache.{hits,misses,evictions}.
+
+_DECODED_CHANGE_CACHE = DecodeCache(
+    int(os.environ.get("AM_DECODE_CACHE_CHANGES", "8192"))
+)
+_DECODED_META_CACHE = DecodeCache(
+    int(os.environ.get("AM_DECODE_CACHE_METAS", "16384"))
+)
+
+
+def decode_change_cached(buffer):
+    """`decode_change` through the bounded decode LRU.
+
+    Returns a SHALLOW COPY of the cached change dict: callers may attach
+    top-level keys (the farm adds ``change["buffer"]``) but must treat the
+    shared ``ops``/``deps`` values as immutable."""
+    key = bytes(buffer)
+    change = _DECODED_CHANGE_CACHE.get(key)
+    if change is None:
+        change = decode_change(key)
+        _DECODED_CHANGE_CACHE.put(key, change)
+    return dict(change)
+
+
+def decode_change_meta_cached(buffer):
+    """`decode_change_meta(buffer, compute_hash=True)` through the decode
+    LRU. Returns a shallow copy; the shared ``deps``/``change`` values must
+    be treated as immutable."""
+    key = bytes(buffer)
+    meta = _DECODED_META_CACHE.get(key)
+    if meta is None:
+        meta = decode_change_meta(key, True)
+        _DECODED_META_CACHE.put(key, meta)
+    return dict(meta)
+
+
+def clear_decode_caches():
+    """Empties both decode LRUs (testing hook; never required for
+    correctness — entries are keyed by immutable bytes)."""
+    _DECODED_CHANGE_CACHE.clear()
+    _DECODED_META_CACHE.clear()
 
 
 def decode_change_meta(buffer, compute_hash):

@@ -7,6 +7,7 @@ Mirrors the semantics of the reference implementation's shared utilities
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 
 _OPID_RE = re.compile(r"^(\d+)@(.*)$")
 
@@ -69,12 +70,15 @@ def lamport_compare_key(ts: str):
     return (0, ts)
 
 
+@lru_cache(maxsize=8192)
 def utf16_key(s: str) -> bytes:
     """Sort key giving JavaScript's UTF-16 code-unit string ordering.
 
     The reference engine compares map keys with JS `<` (UTF-16 code units,
     see /root/reference/backend/new.js:1156); comparing the UTF-16-BE
-    encoding byte-wise is equivalent.
+    encoding byte-wise is equivalent. Cached: the farm's run-segmentation
+    pass compares the same few map keys once per op (pure function of the
+    string, so a bounded LRU is always safe).
     """
     return s.encode("utf-16-be", "surrogatepass")
 

@@ -15,7 +15,7 @@ from math import ceil
 
 from . import backend as Backend
 from .codecs import Decoder, Encoder, bytes_to_hex, hex_to_bytes
-from .columnar import decode_change_meta
+from .columnar import decode_change_meta_cached
 from .errors import AutomergeError, EncodeError, SyncProtocolError
 from .obs.metrics import get_metrics
 from .testing.faults import fire as _fault_point
@@ -222,7 +222,7 @@ def decode_sync_state(data):
 
 def make_bloom_filter(backend, last_sync):
     new_changes = Backend.get_changes(backend, last_sync)
-    hashes = [decode_change_meta(change, True)["hash"] for change in new_changes]
+    hashes = [decode_change_meta_cached(change)["hash"] for change in new_changes]
     return {"lastSync": last_sync, "bloom": BloomFilter(hashes).bytes}
 
 
@@ -242,7 +242,7 @@ def get_changes_to_send(backend, have, need):
         bloom_filters.append(BloomFilter(h["bloom"]))
 
     changes = [
-        decode_change_meta(change, True)
+        decode_change_meta_cached(change)
         for change in Backend.get_changes(backend, list(last_sync_hashes.keys()))
     ]
 
@@ -340,14 +340,14 @@ def generate_sync_message(backend, sync_state):
         return sync_state, None
 
     changes_to_send = [
-        c for c in changes_to_send if not sent_hashes.get(decode_change_meta(c, True)["hash"])
+        c for c in changes_to_send if not sent_hashes.get(decode_change_meta_cached(c)["hash"])
     ]
 
     sync_message = {"heads": our_heads, "have": our_have, "need": our_need, "changes": changes_to_send}
     if changes_to_send:
         sent_hashes = dict(sent_hashes)
         for change in changes_to_send:
-            sent_hashes[decode_change_meta(change, True)["hash"]] = True
+            sent_hashes[decode_change_meta_cached(change)["hash"]] = True
 
     sync_state = dict(sync_state, lastSentHeads=our_heads, sentHashes=sent_hashes)
     encoded = encode_sync_message(sync_message)

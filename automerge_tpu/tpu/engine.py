@@ -30,6 +30,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..obs.metrics import get_metrics
 from ..testing.faults import fire as _fault_point
@@ -333,18 +334,31 @@ def batched_visible_state(state: BatchedDocState, actor_rank=None):
     return _dispatch(_batched_visible_state_cmp, state, cmp)
 
 
+@jax.jit
+def _gather_rows(visible, totals, idx):
+    """Row gather for the incremental readback path: `idx` is a flat array
+    of ``doc * capacity + row`` indices (padded to a power-of-two length so
+    jit shapes are bucketed; the host trims the padding)."""
+    return visible.reshape(-1)[idx], totals.reshape(-1)[idx]
+
+
 class BatchedMapEngine:
     """Host-side driver for the batched map/counter engine.
 
     Maintains the dense device state for a batch of documents. The capacity
     doubles when a merge would overflow, bucketing shapes by powers of two so
-    recompiles are amortised.
+    recompiles are amortised. ``version`` counts committed merges; the
+    visibility pytree is memoised per version so that repeated reads between
+    merges (patch assembly, whole-doc scans, scoped readbacks) cost one
+    device dispatch per merge, not one per read.
     """
 
     def __init__(self, num_docs: int, capacity: int = 1024):
         self.num_docs = num_docs
         self.capacity = capacity
         self.state = make_empty_state(num_docs, capacity)
+        self.version = 0
+        self._vis_memo = None  # ((version, rank_bytes), visibility pytree)
 
     def apply_batch(self, changes: ChangeOpsBatch) -> BatchedDocState:
         _fault_point("engine.apply_batch", changes=changes)
@@ -354,11 +368,40 @@ class BatchedMapEngine:
             self.state = _grow_state(self.state, self.capacity)
             _M_STATE_GROWS.inc()
         self.state = _dispatch(batched_apply_ops, self.state, changes)
+        self.version += 1
+        self._vis_memo = None
         return self.state
 
     def visible_state(self, actor_rank=None):
+        """Device-resident visibility pytree (see batched_visible_state),
+        memoised per (state version, actor-rank table)."""
         _fault_point("engine.visible_state")
-        return batched_visible_state(self.state, actor_rank=actor_rank)
+        rank_key = (
+            None if actor_rank is None else np.asarray(actor_rank).tobytes()
+        )
+        key = (self.version, rank_key)
+        if self._vis_memo is not None and self._vis_memo[0] == key:
+            return self._vis_memo[1]
+        out = batched_visible_state(self.state, actor_rank=actor_rank)
+        self._vis_memo = (key, out)
+        return out
+
+    def read_visibility_rows(self, flat_idx, actor_rank=None):
+        """Scoped device→host visibility readback: (visible, value_total)
+        numpy arrays for just the rows named by `flat_idx` (flattened
+        ``doc * capacity + row`` indices), via one padded device gather and
+        ONE jax.device_get — the transfer is O(rows requested), not O(whole
+        farm state)."""
+        n = int(flat_idx.shape[0])
+        if n == 0:
+            return np.zeros(0, bool), np.zeros(0, np.int64)
+        _, _, visible, _, totals = self.visible_state(actor_rank)
+        padded = 1 << max(0, n - 1).bit_length()
+        idx = np.zeros(padded, np.int32)
+        idx[:n] = flat_idx
+        v, t = _dispatch(_gather_rows, visible, totals, jnp.asarray(idx))
+        v, t = jax.device_get((v, t))
+        return v[:n], t[:n]
 
 
 def _grow_state(state: BatchedDocState, capacity: int) -> BatchedDocState:

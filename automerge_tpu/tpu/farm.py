@@ -7,10 +7,15 @@ merge + visibility/conflict computation running as one batched device
 program per call (engine.batched_apply_ops / batched_visible_state).
 
 Division of labour:
-- **Host**: change decoding (columnar -> op dicts), the causal gate
+- **Host**: change decoding (columnar -> op dicts, memoised in a bounded
+  LRU so a change gossiped to N documents is parsed once), the causal gate
   (dedup by hash, dependency check, per-actor seq contiguity — the port of
   new.js:1550-1597), op transcoding to dense rows, and patch *assembly*
-  from device-computed visibility.
+  from device-computed visibility. Assembly reads a host ROW MIRROR of the
+  device op table (static columns replicated with zero transfers; the
+  merge-dependent visibility/total columns cached per (doc, slot) and
+  refreshed from the device only for spans a commit invalidated) and runs
+  as column operations — see README "Performance".
 - **Device**: the op-table merge (succ/overwrite resolution) and the
   visibility/winner/counter-total computation for every document in the
   batch — the work the reference does per-doc in mergeDocChangeOps
@@ -55,7 +60,7 @@ from typing import NamedTuple
 
 import numpy as np
 
-from ..columnar import decode_change, decode_change_meta
+from ..columnar import decode_change_cached, decode_change_meta_cached
 from ..common import utf16_key
 from ..errors import (
     CausalityError,
@@ -75,9 +80,16 @@ from .engine import (
     ACTOR_MASK,
     BatchedMapEngine,
     PAD_KEY,
+    _MKEY_OP_BITS,
     changes_from_numpy,
 )
-from .transcode import _Interner, _MAX_SLOTS, actor_rank_table
+from .transcode import (
+    _Interner,
+    _MAX_SLOTS,
+    actor_rank_table,
+    lamport_keys,
+    ragged_spans,
+)
 
 
 class ValueCell(NamedTuple):
@@ -149,6 +161,23 @@ _M_FB_DOCS = _METRICS.counter(
 _M_BISECT = _METRICS.counter(
     "farm.bisect.rounds",
     "bisection probes run to isolate device-poison documents",
+)
+_M_RB_ROWS = _METRICS.counter(
+    "farm.readback.rows",
+    "rows transferred device→host by the scoped visibility readback",
+)
+_M_RB_SKIPPED = _METRICS.counter(
+    "farm.readback.rows_skipped",
+    "live rows NOT transferred because their cached visibility was fresh "
+    "(what the old full readback would have paid)",
+)
+_M_RB_HITS = _METRICS.counter(
+    "farm.readback.cache_hits",
+    "(doc, slot) spans served from the host visibility cache",
+)
+_M_VECTOR_ROWS = _METRICS.counter(
+    "farm.assembly.vector_rows",
+    "rows processed by the vectorized (column-mask) assembly path",
 )
 
 # One counter family for every per-doc quarantine cause, dimensioned by the
@@ -279,6 +308,26 @@ class TpuDocFarm:
         self.fault_counts = [0] * num_docs
         self.quarantine: dict[int, BaseException] = {}
         self.degraded: set[int] = set()
+        # host mirror of the device op table (incremental readback, README
+        # "Performance"): per doc, the live rows in exact device order —
+        # the host produced every row and the merge insert position is
+        # deterministic (engine._merge_one_doc), so key/op/action never
+        # need a device transfer. visible/total are a per-(doc, slot)
+        # cache refreshed from the device only for slots invalidated by a
+        # commit; steady-state sync rounds read back only deltas.
+        self._vis_mkey = [np.empty(0, np.int64) for _ in range(num_docs)]
+        self._vis_key = [np.empty(0, np.int32) for _ in range(num_docs)]
+        self._vis_op = [np.empty(0, np.int64) for _ in range(num_docs)]
+        self._vis_action = [np.empty(0, np.int32) for _ in range(num_docs)]
+        self._vis_visible = [np.empty(0, bool) for _ in range(num_docs)]
+        self._vis_total = [np.empty(0, np.int64) for _ in range(num_docs)]
+        self._vis_stale = [set() for _ in range(num_docs)]  # slot ids to re-read
+        self._vis_all_stale = [False] * num_docs
+        # actor-rank table cached per interner size (it only ever grows)
+        self._rank_cache = (0, np.zeros(0, np.int32))
+        # interned value ids that hold ChildObj cells (child detection in
+        # the vectorized children-cache update without a lookup per row)
+        self._child_value_ids: set[int] = set()
 
     # ------------------------------------------------------------------ #
     # transcoding
@@ -349,7 +398,9 @@ class TpuDocFarm:
             "parentKey": parent_key,
             "type": _MAKE_TYPES[action],
         }
-        return self.values.intern(ChildObj(child_id))
+        value = self.values.intern(ChildObj(child_id))
+        self._child_value_ids.add(value)
+        return value
 
     def _grow_elems(self, needed: int):
         from . import rga
@@ -461,7 +512,10 @@ class TpuDocFarm:
         )
 
     def _actor_rank(self):
-        return actor_rank_table(self.actors.table)
+        n = len(self.actors.table)
+        if self._rank_cache[0] != n:  # the interner only ever grows
+            self._rank_cache = (n, actor_rank_table(self.actors.table))
+        return self._rank_cache[1]
 
     def _lamport(self, packed: int):
         return (packed >> ACTOR_BITS, self.actors.lookup(packed & ACTOR_MASK))
@@ -698,7 +752,9 @@ class TpuDocFarm:
 
         Phases (recorded on the ambient PhaseProfile, SURVEY §5.1):
         decode -> walk (exact docs) -> gate+transcode -> pack ->
-        device_dispatch -> visibility -> patch_assembly."""
+        device_dispatch -> visibility (host mirror merge + scoped
+        device readback of stale spans) -> patch_assembly (vectorized
+        over the mirror)."""
         from ..profiling import get_profile
 
         if isolation not in ("doc", "batch"):
@@ -762,7 +818,10 @@ class TpuDocFarm:
                 try:
                     _fault_point("farm.decode", doc=d, buffers=buffers)
                     for buffer in buffers:
-                        change = decode_change(buffer)
+                        # LRU-backed: one parse per distinct change however
+                        # many documents it is gossiped to (shallow copy per
+                        # doc; the shared ops list is never mutated)
+                        change = decode_change_cached(buffer)
                         change["buffer"] = bytes(buffer)
                         decoded.append(change)
                 except Exception as exc:
@@ -886,7 +945,13 @@ class TpuDocFarm:
         # one device merge for the whole batch
         width = max((len(r) for r in per_doc_rows), default=0)
         device_failed = False
+        per_doc_arrays = [None] * self.num_docs
         if width > 0:
+            # dense row columns per doc, shared by pack, the bisect probes
+            # and the host mirror merge
+            for d, rows in enumerate(per_doc_rows):
+                if rows:
+                    per_doc_arrays[d] = np.asarray(rows, np.int64)
             if _METRICS.enabled:
                 rows = sum(len(r) for r in per_doc_rows)
                 cells = self.num_docs * width
@@ -895,7 +960,7 @@ class TpuDocFarm:
                 _M_PAD_RATIO.set(1.0 - rows / cells)
                 _M_OCCUPANCY.observe(rows / cells)
             with prof.phase("pack"):
-                batch = self._pack_rows(per_doc_rows, width=width)
+                batch = self._pack_rows(per_doc_arrays, width=width)
             with prof.phase("device_dispatch"):
                 active = tuple(
                     d for d in range(self.num_docs) if per_doc_rows[d]
@@ -913,7 +978,7 @@ class TpuDocFarm:
                     # sequential reference walk below.
                     device_failed = True
                     _M_FB_CALLS.inc()
-                    poison = self._bisect_device_faults(per_doc_rows, active)
+                    poison = self._bisect_device_faults(per_doc_arrays, active)
                     for d in sorted(poison):
                         quarantine(d, DeviceFaultError(
                             f"batched device dispatch fails with document "
@@ -949,11 +1014,16 @@ class TpuDocFarm:
             if d not in exact_patches and d not in failures
         ]
         with prof.phase("visibility"):
-            vis = (
-                self._read_visibility()
-                if width > 0 and need_device_patch and not device_failed
-                else None
-            )
+            if width > 0 and not device_failed:
+                # replicate the committed merge on the host mirror (exact
+                # device row order, no transfer), then refresh the stale
+                # (doc, slot) visibility spans with one scoped gather
+                for d, arr in enumerate(per_doc_arrays):
+                    if arr is not None:
+                        self._merge_mirror(d, arr)
+                self._refresh_visibility(
+                    [d for d in need_device_patch if applied_ops[d]]
+                )
         with prof.phase("patch_assembly"):
             patches = []
             outcomes = []
@@ -979,7 +1049,7 @@ class TpuDocFarm:
                     patches.append(exact_patches[d])
                     continue
                 cutoffs = self._compute_cutoffs(d, applied_ops[d])
-                diffs = self._build_diffs(d, vis, cutoffs, touched_objects[d])
+                diffs = self._build_diffs(d, cutoffs, touched_objects[d])
                 patch = {
                     "maxOp": self.max_op[d],
                     "clock": self.clock[d],
@@ -1055,6 +1125,11 @@ class TpuDocFarm:
         self.elem_index[d] = snap["elem_index"]
         self.elem_ids[d] = snap["elem_ids"]
         self.elem_object[d] = snap["elem_object"]
+        # a rolled-back delivery must never be served stale visibility:
+        # conservatively mark every span of the doc for re-read (cheap —
+        # rollback is the rare path)
+        self._vis_all_stale[d] = True
+        self._vis_stale[d].clear()
 
     def _noop_patch(self, d: int) -> dict:
         """The patch of a delivery that changed nothing (quarantined/shed):
@@ -1067,29 +1142,33 @@ class TpuDocFarm:
             "diffs": _empty_object_patch("_root", "map"),
         }
 
-    def _pack_rows(self, per_doc_rows, width=None, only=None):
-        """Packs per-doc dense rows into padded device tensors. `only`
-        restricts to a subset of docs (others all-padding) for bisection
-        probes."""
+    def _pack_rows(self, per_doc_arrays, width=None, only=None):
+        """Packs per-doc dense row column arrays ([n, 5] int64 of
+        (slot, op, action, value, pred); None for empty docs) into padded
+        device tensors by whole-column assignment. `only` restricts to a
+        subset of docs (others all-padding) for bisection probes."""
         if width is None:
-            width = max((len(r) for r in per_doc_rows), default=0) or 1
+            width = max(
+                (a.shape[0] for a in per_doc_arrays if a is not None),
+                default=0,
+            ) or 1
         keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
         ops = np.zeros((self.num_docs, width), np.int64)
         actions = np.zeros((self.num_docs, width), np.int32)
         values = np.zeros((self.num_docs, width), np.int64)
         preds = np.full((self.num_docs, width), -1, np.int64)
-        for d, rows in enumerate(per_doc_rows):
-            if only is not None and d not in only:
+        for d, arr in enumerate(per_doc_arrays):
+            if arr is None or (only is not None and d not in only):
                 continue
-            for i, (slot, packed, action, value, pred) in enumerate(rows):
-                keys[d, i] = slot
-                ops[d, i] = packed
-                actions[d, i] = action
-                values[d, i] = value
-                preds[d, i] = pred
+            n = arr.shape[0]
+            keys[d, :n] = arr[:, 0]
+            ops[d, :n] = arr[:, 1]
+            actions[d, :n] = arr[:, 2]
+            values[d, :n] = arr[:, 3]
+            preds[d, :n] = arr[:, 4]
         return changes_from_numpy(keys, ops, actions, values, preds)
 
-    def _bisect_device_faults(self, per_doc_rows, active):
+    def _bisect_device_faults(self, per_doc_arrays, active):
         """Isolates the doc(s) whose rows crash the batched device program
         by bisection: each probe dispatches a subset's rows against a
         throwaway copy of the engine state (the real state is never
@@ -1106,7 +1185,7 @@ class TpuDocFarm:
                 _fault_point("farm.device_dispatch", docs=tuple(group))
                 state = jax.tree_util.tree_map(jnp.copy, self.engine.state)
                 out = batched_apply_ops(
-                    state, self._pack_rows(per_doc_rows, only=set(group))
+                    state, self._pack_rows(per_doc_arrays, only=set(group))
                 )
                 jax.block_until_ready(out)
                 return True
@@ -1171,49 +1250,175 @@ class TpuDocFarm:
         return released
 
     # ------------------------------------------------------------------ #
-    # patch assembly from device visibility
+    # incremental visibility: host row mirror + scoped device readback
+    #
+    # The host transcoded every dispatched row and the device merge insert
+    # position is a pure function of the sorted merge keys
+    # (engine._merge_one_doc: left-searchsorted + stable order), so the
+    # static row columns (key, packed opId, action) are replicated on the
+    # host with zero device traffic. Only the merge-DEPENDENT columns —
+    # per-row visibility and counter totals — come from the device, and
+    # only for the (doc, slot) spans invalidated since they were last read:
+    # a delivery touching 3 objects in 2 documents reads back a handful of
+    # rows, not the whole farm state.
+
+    def _merge_mirror(self, d, arr):
+        """Replays a committed device merge on doc `d`'s host mirror.
+        `arr` is the [n, 5] (slot, op, action, value, pred) column array
+        this call dispatched; rows land at exactly the device's insert
+        positions (stable sort + left-searchsorted, so multi-pred marker
+        rows keep sorting directly after their primary)."""
+        mkey = (arr[:, 0] << _MKEY_OP_BITS) | arr[:, 1]
+        order = np.argsort(mkey, kind="stable")
+        mkey = mkey[order]
+        pos = np.searchsorted(self._vis_mkey[d], mkey)
+        self._vis_mkey[d] = np.insert(self._vis_mkey[d], pos, mkey)
+        self._vis_key[d] = np.insert(
+            self._vis_key[d], pos, arr[order, 0].astype(np.int32)
+        )
+        self._vis_op[d] = np.insert(self._vis_op[d], pos, arr[order, 1])
+        self._vis_action[d] = np.insert(
+            self._vis_action[d], pos, arr[order, 2].astype(np.int32)
+        )
+        # placeholders until the scoped readback refreshes these spans
+        self._vis_visible[d] = np.insert(self._vis_visible[d], pos, False)
+        self._vis_total[d] = np.insert(self._vis_total[d], pos, 0)
+        if not self._vis_all_stale[d]:
+            self._vis_stale[d].update(np.unique(arr[:, 0]).tolist())
+
+    def _refresh_visibility(self, docs):
+        """Brings the visibility cache of `docs` up to date: ONE batched
+        device gather covering exactly the stale (doc, slot) spans. Fresh
+        docs cost nothing; in the steady state only the rows a delivery
+        touched cross the device boundary."""
+        plan = []
+        gathered = 0
+        live = 0
+        for d in docs:
+            mkey = self._vis_mkey[d]
+            if mkey.shape[0] == 0:
+                self._vis_all_stale[d] = False
+                self._vis_stale[d].clear()
+                continue
+            live += mkey.shape[0]
+            if self._vis_all_stale[d]:
+                idx = np.arange(mkey.shape[0])
+            elif self._vis_stale[d]:
+                slots = np.fromiter(
+                    self._vis_stale[d], np.int64, len(self._vis_stale[d])
+                )
+                slots.sort()
+                _, _, idx, _ = ragged_spans(mkey, slots)
+            else:
+                if _METRICS.enabled:
+                    _M_RB_HITS.inc(self._live_slot_count(d))
+                continue
+            if _METRICS.enabled:
+                fresh = self._live_slot_count(d) - (
+                    0 if self._vis_all_stale[d] else len(self._vis_stale[d])
+                )
+                _M_RB_HITS.inc(max(fresh, 0))
+            plan.append((d, idx))
+            gathered += idx.shape[0]
+        if _METRICS.enabled:
+            _M_RB_ROWS.inc(gathered)
+            _M_RB_SKIPPED.inc(live - gathered)
+        if not plan:
+            return
+        capacity = self.engine.capacity
+        flat = np.concatenate(
+            [d * capacity + idx for d, idx in plan]
+        ).astype(np.int32)
+        rank = self._actor_rank() if self.actors.table else None
+        visible, totals = self.engine.read_visibility_rows(
+            flat, actor_rank=rank
+        )
+        offset = 0
+        for d, idx in plan:
+            n = idx.shape[0]
+            self._vis_visible[d][idx] = visible[offset:offset + n]
+            self._vis_total[d][idx] = totals[offset:offset + n]
+            offset += n
+            self._vis_all_stale[d] = False
+            self._vis_stale[d].clear()
+
+    def _live_slot_count(self, d):
+        keys = self._vis_key[d]
+        if keys.shape[0] == 0:
+            return 0
+        return int((keys[1:] != keys[:-1]).sum()) + 1
+
+    # ------------------------------------------------------------------ #
+    # patch assembly from the visibility mirror
 
     def _read_visibility(self):
+        """Full-state readback — the reference path the incremental mirror
+        is verified against (tests/test_parity_incremental.py): one batched
+        ``jax.device_get`` of the whole visibility pytree instead of five
+        separate per-array transfers. Production paths use the mirror; this
+        exists for whole-state debugging and the parity suite."""
+        import jax
+
         keys, ops, visible, _winners, totals = self.engine.visible_state(
             actor_rank=self._actor_rank() if self.actors.table else None
         )
-        return (
-            np.asarray(keys),
-            np.asarray(ops),
-            np.asarray(visible),
-            np.asarray(totals),
-            np.asarray(self.engine.state.action),
+        return jax.device_get(
+            (keys, ops, visible, totals, self.engine.state.action)
         )
 
-    def _slot_rows(self, d, vis, slot):
-        """All walkable rows of one slot in ascending opId order (the row
-        sort order): [(packed, action, visible, total)]. Deletion rows and
-        multi-pred marker rows are skipped — the reference stores deletions
-        only as succ entries, so its walk never visits them."""
-        keys, ops, visible, totals, actions = vis
-        row_keys = keys[d]
-        lo = np.searchsorted(row_keys, slot, side="left")
-        hi = np.searchsorted(row_keys, slot, side="right")
-        out = []
-        for i in range(lo, hi):
-            if actions[d, i] == ACTION_DEL:
-                continue
-            out.append(
-                (int(ops[d, i]), int(actions[d, i]), bool(visible[d, i]),
-                 int(totals[d, i]))
-            )
-        # the engine table sorts by actor intern index; the reference walk
-        # order ties same-counter ops on the actor id string
-        out.sort(key=lambda r: self._lamport(r[0]))
-        return out
+    def _slot_span(self, d, slot):
+        mkey = self._vis_mkey[d]
+        lo = np.searchsorted(mkey, np.int64(slot) << _MKEY_OP_BITS)
+        hi = np.searchsorted(mkey, (np.int64(slot) + 1) << _MKEY_OP_BITS)
+        return int(lo), int(hi)
 
-    def _visible_rows(self, d, vis, slot):
-        """[(packed_opid, value_total)] of visible set rows for one slot."""
+    def _slot_rows(self, d, slot):
+        """All walkable rows of one slot in reference walk order:
+        [(packed, action, visible, total)], served from the host mirror
+        (callers refresh first). Deletion rows and multi-pred marker rows
+        are dropped as a column mask BEFORE any per-row materialisation —
+        the reference stores deletions only as succ entries, so its walk
+        never visits them. Walk order ties same-counter ops on the actor id
+        STRING via the precomputed rank table, not a per-row sort key."""
+        lo, hi = self._slot_span(d, slot)
+        if lo == hi:
+            return []
+        span = slice(lo, hi)
+        act = self._vis_action[d][span]
+        keep = act != ACTION_DEL
+        ops = self._vis_op[d][span][keep]
+        if ops.shape[0] == 0:
+            return []
+        act = act[keep]
+        vis = self._vis_visible[d][span][keep]
+        tot = self._vis_total[d][span][keep]
+        order = np.argsort(
+            lamport_keys(ops, self._actor_rank()), kind="stable"
+        )
         return [
-            (packed, total)
-            for packed, action, visible, total in self._slot_rows(d, vis, slot)
-            if visible and action == ACTION_SET
+            (int(o), int(a), bool(v), int(t))
+            for o, a, v, t in zip(ops[order], act[order], vis[order], tot[order])
         ]
+
+    def _visible_rows(self, d, slot):
+        """[(packed_opid, value_total)] of visible set rows for one slot —
+        the visible/action filters run as column masks before any rows are
+        materialised into Python tuples."""
+        lo, hi = self._slot_span(d, slot)
+        if lo == hi:
+            return []
+        span = slice(lo, hi)
+        mask = self._vis_visible[d][span] & (
+            self._vis_action[d][span] == ACTION_SET
+        )
+        if not mask.any():
+            return []
+        ops = self._vis_op[d][span][mask]
+        tot = self._vis_total[d][span][mask]
+        order = np.argsort(
+            lamport_keys(ops, self._actor_rank()), kind="stable"
+        )
+        return [(int(o), int(t)) for o, t in zip(ops[order], tot[order])]
 
     def _value_diff(self, d, patches, packed, total):
         """The valueDiff for one visible row (updatePatchProperty's values,
@@ -1240,23 +1445,6 @@ class TpuDocFarm:
             )
         return patches[object_id]
 
-    def _emitted_rows(self, d, rows, cutoff):
-        """The visible set rows (from _slot_rows) the sequential walk would
-        have emitted under `cutoff` (see _compute_cutoffs): opId <= cutoff,
-        counters only when every inc successor was walked too."""
-        out = []
-        for packed, action, visible, total in rows:
-            if not visible or action != ACTION_SET:
-                continue
-            if self._lamport(packed) > cutoff:
-                continue
-            if packed in self.counter_ops[d] and not self._counter_emits(
-                d, packed, cutoff
-            ):
-                continue
-            out.append((packed, total))
-        return out
-
     def _counter_emits(self, d, packed, cutoff):
         """A counter emits only when its succ list drains during the walk:
         every inc targeting it must be walked (<= cutoff) and actually
@@ -1281,41 +1469,60 @@ class TpuDocFarm:
             diff["datatype"] = cell.datatype
         return diff
 
-    def _update_children_cache(self, d, slot, cutoff, rows):
-        """Replays the walk's per-op cache updates for one slot.
+    def _children_cache_segment(self, d, slot, seg, ops, tot, spec, walked,
+                                is_ctr):
+        """Replays the walk's per-op children-cache updates for one slot
+        from the assembly column masks.
 
         The reference re-evaluates `hasChild or prev_children` at EVERY
         walked op, reading the cache live (new.js:923-935): once a walk
-        shrinks the cache to empty, later ops of the same walk can no longer
-        update it (the gate reads the now-empty cache), so the final cache
-        is order-dependent. Counters with inc successors never enter
-        visibleOps (their succNum > 0), and inc ops enter visibleOps but
-        not the cached values."""
-        cache = self.children[d].get(slot)
-        specs = []  # cached (opId, spec) accumulated in walk order
+        shrinks the cache to empty, later ops of the same walk can no
+        longer update it (the gate reads the now-empty cache), so the final
+        cache is order-dependent. Because the cached spec set only ever
+        GROWS during one walk, the whole state machine collapses to three
+        outcomes: a walked child spec anywhere re-opens the gate for good
+        (cache := all walked specs); otherwise a truthy pre-existing cache
+        updates to all walked specs when the FIRST walked op produced a
+        spec, and sticks shut at {} when it did not; an absent/empty cache
+        with no child stays untouched. Counters with inc successors never
+        enter visibleOps (their succNum > 0) and inc ops enter visibleOps
+        but not the cached values — both already excluded from `spec`."""
+        s, e = seg
+        if e == s or not walked[s]:
+            return  # walked is a prefix of the lamport-ordered segment
+        spec_idx = np.nonzero(spec[s:e])[0] + s
         has_child = False
-        updated = False
-        for packed, action, visible, total in rows:
-            if self._lamport(packed) > cutoff:
-                break  # rows are in ascending opId order; the rest unwalked
-            if action == ACTION_SET:
-                ref_overwritten = (not visible) or (
-                    packed in self.counter_ops[d] and packed in self.inc_max[d]
+        for j in spec_idx:
+            if (is_ctr is None or not is_ctr[j]) and (
+                int(tot[j]) in self._child_value_ids
+            ):
+                has_child = True
+                break
+        cache = self.children[d].get(slot)
+        if has_child or (cache and spec[s]):
+            self.children[d][slot] = {
+                self._opid_str(int(ops[j])): self._cache_spec(
+                    d, int(ops[j]), int(tot[j])
                 )
-                if not ref_overwritten:
-                    spec = self._cache_spec(d, packed, total)
-                    specs.append((self._opid_str(packed), spec))
-                    has_child = has_child or isinstance(spec, tuple)
-            if has_child or cache:
-                cache = dict(specs)
-                updated = True
-        if updated:
-            self.children[d][slot] = cache
+                for j in spec_idx
+            }
+        elif cache:
+            self.children[d][slot] = {}
 
-    def _visible_sequence(self, d, vis, ranks, obj):
+    def _pack_lamport(self, cutoff, rank):
+        """A (counter, actorId) lamport cutoff as an int64 comparable
+        against the remapped lamport key column; _INF maps to int64 max."""
+        ctr, actor = cutoff
+        if ctr == float("inf"):
+            return np.iinfo(np.int64).max
+        idx = self.actors.find(actor)
+        assert idx is not None, f"cutoff actor {actor!r} never interned"
+        return (int(ctr) << ACTOR_BITS) | int(rank[idx])
+
+    def _visible_sequence(self, d, ranks, obj):
         """One list object's visible elements in document order:
-        [(elemId, winner_packed, total)] — device ranks give the order,
-        device visibility/winners give each element's surviving value."""
+        [(elemId, winner_packed, total)] — device ranks give the order, the
+        visibility mirror gives each element's surviving value."""
         n = int(self.num_elems[d])
         if n == 0:
             return []
@@ -1328,7 +1535,7 @@ class TpuDocFarm:
             elem_id = self.elem_ids[d][idx]
             slot = self.slots.intern((obj, elem_id))
             best = None
-            for packed, action, visible, total in self._slot_rows(d, vis, slot):
+            for packed, action, visible, total in self._slot_rows(d, slot):
                 if not visible or action != ACTION_SET:
                     continue
                 if packed in self.counter_ops[d] and packed in self.starved[d]:
@@ -1339,26 +1546,96 @@ class TpuDocFarm:
                 seq.append((elem_id, best[0], best[1]))
         return seq
 
-    def _build_diffs(self, d, vis, cutoffs, touched_objects):
-        """Patch assembly for map-family docs from device visibility. Docs
-        that touch list/text objects never reach this path (they are served
-        by the embedded reference walk; see apply_changes)."""
+    def _build_diffs(self, d, cutoffs, touched_objects):
+        """Patch assembly for map-family docs from the visibility mirror.
+        Docs that touch list/text objects never reach this path (they are
+        served by the embedded reference walk; see apply_changes).
+
+        The old per-slot inner loops are column operations here: slot spans
+        come from one batched searchsorted pair (ragged_spans), walk order
+        from a precomputed lamport sort-key column (lamport_keys — actor
+        bits remapped to lexicographic ranks, replacing the per-row
+        ``sort(key=...)`` callback), and the action/visibility/cutoff
+        filters are boolean masks — per-row Python runs only for the rows
+        that actually land in the patch."""
         patches = {"_root": _empty_object_patch("_root", "map")}
 
-        for slot in sorted(cutoffs):
-            obj, key = self.slots.lookup(slot)
-            if obj not in self.object_meta[d]:
-                continue
-            patch = self._ensure_patch(d, patches, obj)
-            rows = self._slot_rows(d, vis, slot)
-            emitted = self._emitted_rows(d, rows, cutoffs[slot])
-            # each walk resets the key's conflict map (new.js:1000)
-            props = patch["props"][key] = {}
-            for packed, total in emitted:
-                props[self._opid_str(packed)] = self._value_diff(
-                    d, patches, packed, total
+        if cutoffs:
+            slot_list = sorted(cutoffs)
+            slots = np.asarray(slot_list, np.int64)
+            _, _, idx, grp = ragged_spans(self._vis_mkey[d], slots)
+            act = self._vis_action[d][idx]
+            # the reference walk never visits deletion/marker rows
+            keep = act != ACTION_DEL
+            idx = idx[keep]
+            grp = grp[keep]
+            act = act[keep]
+            ops = self._vis_op[d][idx]
+            vis = self._vis_visible[d][idx]
+            tot = self._vis_total[d][idx]
+            rank = self._actor_rank()
+            lam = lamport_keys(ops, rank)
+            order = np.argsort(
+                (grp.astype(np.int64) << _MKEY_OP_BITS) | lam, kind="stable"
+            )
+            grp = grp[order]
+            ops = ops[order]
+            act = act[order]
+            vis = vis[order]
+            tot = tot[order]
+            lam = lam[order]
+            if _METRICS.enabled:
+                _M_VECTOR_ROWS.inc(int(ops.shape[0]))
+
+            cut = np.empty(len(slot_list), np.int64)
+            for i, slot in enumerate(slot_list):
+                cut[i] = self._pack_lamport(cutoffs[slot], rank)
+            walked = lam <= cut[grp]
+            emit = vis & (act == ACTION_SET) & walked
+            spec = emit.copy()
+            is_ctr = None
+            if self.counter_ops[d]:
+                ctr_arr = np.fromiter(
+                    self.counter_ops[d], np.int64, len(self.counter_ops[d])
                 )
-            self._update_children_cache(d, slot, cutoffs[slot], rows)
+                is_ctr = np.isin(ops, ctr_arr)
+                # counters emit only once their succ list drains; the
+                # children cache drops counters with ANY registered inc
+                for j in np.nonzero(is_ctr & emit)[0]:
+                    if not self._counter_emits(
+                        d, int(ops[j]), cutoffs[slot_list[int(grp[j])]]
+                    ):
+                        emit[j] = False
+                for j in np.nonzero(is_ctr & spec)[0]:
+                    if int(ops[j]) in self.inc_max[d]:
+                        spec[j] = False
+
+            bounds = np.searchsorted(
+                grp, np.arange(slots.shape[0] + 1)
+            )
+            # with no ChildObj ever interned the cache gate can never open
+            # (has_child is impossible and no truthy cache can exist), so
+            # the per-slot replay is skipped wholesale
+            track_children = bool(self._child_value_ids) or bool(
+                self.children[d]
+            )
+            for i, slot in enumerate(slot_list):
+                obj, key = self.slots.lookup(slot)
+                if obj not in self.object_meta[d]:
+                    continue
+                patch = self._ensure_patch(d, patches, obj)
+                # each walk resets the key's conflict map (new.js:1000)
+                props = patch["props"][key] = {}
+                s, e = int(bounds[i]), int(bounds[i + 1])
+                for j in np.nonzero(emit[s:e])[0] + s:
+                    packed = int(ops[j])
+                    props[self._opid_str(packed)] = self._value_diff(
+                        d, patches, packed, int(tot[j])
+                    )
+                if track_children:
+                    self._children_cache_segment(
+                        d, slot, (s, e), ops, tot, spec, walked, is_ctr
+                    )
 
         # link touched objects up to the root (setupPatches, new.js:1461)
         for object_id in sorted(touched_objects):
@@ -1410,14 +1687,15 @@ class TpuDocFarm:
         # embedded walk is authoritative for whole-doc reads too
         if d in self.degraded and self.exact[d] is not None:
             return self.exact[d].get_patch()
-        vis = self._read_visibility()
+        # whole-doc reads ride the same mirror: only this doc's stale
+        # spans (if any) cross the device boundary
+        self._refresh_visibility([d])
         ranks = (
             self._element_ranks() if int(self.num_elems[d]) > 0 else None
         )
-        keys = vis[0][d]
         patches = {"_root": _empty_object_patch("_root", "map")}
         list_objects = set()
-        slots_here = sorted({int(s) for s in keys if s != PAD_KEY})
+        slots_here = np.unique(self._vis_key[d]).tolist()
         for slot in slots_here:
             obj, key = self.slots.lookup(slot)
             if obj not in self.object_meta[d]:
@@ -1427,7 +1705,7 @@ class TpuDocFarm:
                 continue
             rows = [
                 (packed, total)
-                for packed, total in self._visible_rows(d, vis, slot)
+                for packed, total in self._visible_rows(d, slot)
                 if packed not in self.counter_ops[d]
                 or self._counter_emits(d, packed, self._INF)
             ]
@@ -1446,7 +1724,7 @@ class TpuDocFarm:
         for obj in sorted(list_objects):
             patch = self._ensure_patch(d, patches, obj)
             for index, (elem_id, packed, total) in enumerate(
-                self._visible_sequence(d, vis, ranks, obj)
+                self._visible_sequence(d, ranks, obj)
             ):
                 append_edit(patch["edits"], {
                     "action": "insert", "index": index, "elemId": elem_id,
@@ -1508,7 +1786,7 @@ class TpuDocFarm:
                 seen.add(h)
         return [
             change for change in self.changes[d]
-            if decode_change_meta(change, True)["hash"] not in seen
+            if decode_change_meta_cached(change)["hash"] not in seen
         ]
 
     def get_missing_deps(self, d: int, heads=()):

@@ -162,12 +162,12 @@ def batched_have_filters(backends, last_syncs):
     in one device program (the batched analogue of makeBloomFilter,
     sync.js:234)."""
     from .. import backend as Backend
-    from ..columnar import decode_change_meta
+    from ..columnar import decode_change_meta_cached
 
     hash_lists = []
     for backend, last_sync in zip(backends, last_syncs):
         changes = Backend.get_changes(backend, list(last_sync))
-        hash_lists.append([decode_change_meta(c, True)["hash"] for c in changes])
+        hash_lists.append([decode_change_meta_cached(c)["hash"] for c in changes])
     xyz, counts = pack_hashes(hash_lists)
     num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / WORD_BITS)) or 1
     words, modulo = build_filters(xyz, counts, num_words)

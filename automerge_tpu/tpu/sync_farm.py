@@ -28,7 +28,7 @@ from math import ceil
 
 import numpy as np
 
-from ..columnar import decode_change_meta
+from ..columnar import decode_change_meta_cached
 from ..errors import SyncProtocolError
 from ..obs.metrics import get_metrics
 from ..sync import (
@@ -118,7 +118,7 @@ class SyncFarm:
 
     def _changes_since(self, d, since_hashes):
         changes = self.farm.get_changes(d, list(since_hashes))
-        return [decode_change_meta(c, True) for c in changes]
+        return [decode_change_meta_cached(c) for c in changes]
 
     def generate_messages(self, channels):
         """channels: [(doc, sync_state)]. Returns [(new_state, bytes|None)]
@@ -285,7 +285,7 @@ class SyncFarm:
         changes_to_send = [
             c
             for c in changes_to_send
-            if not sent_hashes.get(decode_change_meta(c, True)["hash"])
+            if not sent_hashes.get(decode_change_meta_cached(c)["hash"])
         ]
         msg = {
             "heads": our_heads,
@@ -296,7 +296,7 @@ class SyncFarm:
         if changes_to_send:
             sent_hashes = dict(sent_hashes)
             for change in changes_to_send:
-                sent_hashes[decode_change_meta(change, True)["hash"]] = True
+                sent_hashes[decode_change_meta_cached(change)["hash"]] = True
         new_state = dict(state, lastSentHeads=our_heads, sentHashes=sent_hashes)
         encoded = encode_sync_message(msg)
         _M_MSGS_GEN.inc()

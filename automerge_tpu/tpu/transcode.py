@@ -25,7 +25,9 @@ from .engine import (
     ACTION_INC,
     ACTION_SET,
     ACTOR_BITS,
+    ACTOR_MASK,
     PAD_KEY,
+    _MKEY_OP_BITS as _SLOT_SHIFT,
     ChangeOpsBatch,
     changes_from_numpy,
 )
@@ -62,6 +64,9 @@ def actor_rank_table(actors, pad_to=None):
     n = len(actors)
     size = max(pad_to or n, n, 1)
     ranks = np.arange(size, dtype=np.int32)  # identity for unused slots
+    # amlint: disable=AM105 — actor-table-sized and cached per interner
+    # size by the farm (not per row, not per call): the callback sort is
+    # off the hot path by construction
     order = sorted(range(n), key=lambda i: actors[i])
     for rank, i in enumerate(order):
         ranks[i] = rank
@@ -102,6 +107,44 @@ class _Interner:
 
     def lookup(self, idx: int):
         return self.table[idx]
+
+    def find(self, value):
+        """Index of an already-interned value (None if absent): a pure
+        lookup that never grows the table, for hot paths that must not
+        perturb packed-id assignment."""
+        try:
+            return self.index.get((value.__class__, value))
+        except TypeError:  # unhashable — identity-interned
+            return self.index.get(id(value))
+
+
+# ---------------------------------------------------------------------- #
+# column helpers for vectorized patch assembly (tpu/farm._build_diffs):
+# per-slot work expressed as array operations over the host row mirror.
+
+def lamport_keys(ops, actor_rank):
+    """int64 column of reference-comparable lamport keys for packed opIds:
+    the actor intern index is replaced by its lexicographic rank
+    (actor_rank_table), so int64 comparison == (counter, actorId-string)
+    comparison — the walk's tie-break — without a per-row sort callback."""
+    return (ops >> ACTOR_BITS << ACTOR_BITS) | actor_rank[ops & ACTOR_MASK]
+
+
+def ragged_spans(sorted_mkey, slots):
+    """Row spans of `slots` (ascending int64 slot ids) in a merge-key-sorted
+    row table: returns (starts, counts, idx, grp) where `idx` flat-indexes
+    every row of every requested slot and ``grp[i]`` is the position in
+    `slots` that ``idx[i]`` belongs to. One batched searchsorted pair
+    replaces a per-slot binary-search loop."""
+    lo = np.searchsorted(sorted_mkey, slots << _SLOT_SHIFT)
+    hi = np.searchsorted(sorted_mkey, (slots + 1) << _SLOT_SHIFT)
+    counts = hi - lo
+    total = int(counts.sum())
+    idx = np.repeat(
+        lo - np.concatenate(([0], counts.cumsum()[:-1])), counts
+    ) + np.arange(total)
+    grp = np.repeat(np.arange(slots.shape[0]), counts)
+    return lo, counts, idx, grp
 
 
 class BatchTranscoder:

@@ -87,10 +87,11 @@ def bench_device(num_docs, capacity, rounds, ops_per_round, seed=0):
     }
 
 
-def _make_change_stream(rounds, ops_per_round, seed=0):
+def _make_change_stream(rounds, ops_per_round, seed=0, schedule=None):
     """One actor's binary change stream for the end-to-end workload (the
     same key-set shape as the device bench, encoded through the real wire
-    format)."""
+    format). `schedule` overrides the per-round op counts (used by the
+    smoke gate's seed-then-deltas shape)."""
     import random
 
     from automerge_tpu.columnar import decode_change_columns, encode_change
@@ -98,11 +99,11 @@ def _make_change_stream(rounds, ops_per_round, seed=0):
     rng = random.Random(seed)
     actor = "aaaaaaaa"
     buffers, last, max_op, deps = [], {}, 0, []
-    for r in range(rounds):
+    for r, round_ops in enumerate(schedule or [ops_per_round] * rounds):
         ops = []
         start_op = max_op + 1
         ctr = start_op
-        for _ in range(ops_per_round):
+        for _ in range(round_ops):
             key = f"k{rng.randrange(64)}"
             ops.append({"action": "set", "obj": "_root", "key": key,
                         "datatype": "uint", "value": rng.randrange(10**6),
@@ -170,6 +171,90 @@ def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
             "sync_bytes_received": _value("sync.bytes.received"),
         },
     }
+
+
+def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
+                delta_ops=4, seed=0):
+    """Regression guard for the incremental-readback/vectorized-assembly
+    work (ISSUE 4). Builds up farm state with `seed_rounds` large rounds
+    (untimed), then times `delta_rounds` small delta rounds — the steady-
+    state sync shape where the host mirror should read back only deltas.
+
+    Two figures of merit:
+    - ``tail_share``: visibility+patch_assembly share of the timed phases.
+      BENCH_r05's O(whole farm)-per-call signature pushes this toward 1.
+    - ``readback_rows`` vs ``readback_rows_skipped``: the scoped gather
+      must transfer a minority of live rows (most spans served from the
+      host cache); a revert to full readback makes skipped collapse to 0.
+    """
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.profiling import PhaseProfile, use_profile
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    schedule = [seed_ops] * seed_rounds + [delta_ops] * delta_rounds
+    buffers = _make_change_stream(0, 0, seed, schedule=schedule)
+    farm = TpuDocFarm(num_docs, capacity=sum(schedule))
+    warm = TpuDocFarm(num_docs, capacity=sum(schedule))
+    warm.apply_changes([[buffers[0]]] * num_docs)
+    for buf in buffers[:seed_rounds]:
+        farm.apply_changes([[buf]] * num_docs)
+
+    metrics = get_metrics()
+    metrics.reset()
+    prof = PhaseProfile()
+    start = time.perf_counter()
+    with use_profile(prof), enabled_metrics():
+        for buf in buffers[seed_rounds:]:
+            farm.apply_changes([[buf]] * num_docs)
+    elapsed = time.perf_counter() - start
+
+    phases = {
+        name: round(entry["total_s"], 4)
+        for name, entry in prof.as_dict().items()
+    }
+    tail = phases.get("visibility", 0.0) + phases.get("patch_assembly", 0.0)
+    denom = sum(phases.values()) or 1.0
+    snap = metrics.as_dict()
+
+    def _value(name):
+        return snap.get(name, {}).get("value", 0)
+
+    return {
+        "ops_per_sec": num_docs * delta_rounds * delta_ops / elapsed,
+        "elapsed_s": elapsed,
+        "phases": phases,
+        "tail_s": round(tail, 4),
+        "tail_share": round(tail / denom, 4),
+        "readback_rows": _value("farm.readback.rows"),
+        "readback_rows_skipped": _value("farm.readback.rows_skipped"),
+        "decode_cache_hits": _value("codecs.decode_cache.hits"),
+        "decode_cache_misses": _value("codecs.decode_cache.misses"),
+    }
+
+
+def _quick_main():
+    """`bench.py --quick`: the CPU smoke gate. One JSON line; exit 1 when
+    the visibility+patch_assembly share exceeds the pinned threshold or
+    the scoped readback stops being incremental."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # host gate: no TPU needed
+    num_docs = int(os.environ.get("BENCH_SMOKE_DOCS", "128"))
+    threshold = float(os.environ.get("BENCH_SMOKE_MAX_TAIL_SHARE", "0.55"))
+    result = bench_smoke(num_docs)
+    incremental = result["readback_rows_skipped"] > result["readback_rows"]
+    ok = result["tail_share"] <= threshold and incremental
+    print(json.dumps({
+        "metric": "visibility+patch_assembly share of delta-round time",
+        "value": result["tail_share"],
+        "unit": "share",
+        "threshold": threshold,
+        "incremental_readback": incremental,
+        "readback_rows": result["readback_rows"],
+        "readback_rows_skipped": result["readback_rows_skipped"],
+        "ok": ok,
+        "ops_per_sec": round(result["ops_per_sec"]),
+        "phases_s": result["phases"],
+    }))
+    sys.exit(0 if ok else 1)
 
 
 def bench_faults(num_docs, rounds, ops_per_round, fault_pct, seed=0):
@@ -417,6 +502,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--quick" in sys.argv:
+        _quick_main()
     elif "--faults" in sys.argv:
         arg_index = sys.argv.index("--faults") + 1
         pct = float(sys.argv[arg_index]) if arg_index < len(sys.argv) else 10.0

@@ -1,0 +1,13 @@
+# amlint: hot-path — fixture: the vectorised equivalent stays clean
+
+
+def slot_rows(ops, actions, visible, lam_keys, argsort):
+    """Column-mask filtering plus a precomputed sort-key column: per-row
+    Python only touches rows that survive the masks."""
+    order = argsort(lam_keys, kind="stable")
+    keep = [j for j in order if visible[j]]
+    return [(ops[j], actions[j]) for j in keep]
+
+
+def winner_totals(totals, emit_mask):
+    return [t for t, emitted in zip(totals, emit_mask) if emitted]

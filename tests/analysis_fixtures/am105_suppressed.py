@@ -1,0 +1,11 @@
+# amlint: hot-path — fixture: justified suppressions silence AM105
+
+
+def debug_rows(ops, visible):
+    """A deliberately-cold debug dump inside a hot module."""
+    out = []
+    for i in range(len(ops)):
+        out.append(int(ops[i]))  # amlint: disable=AM105 — debug-only dump
+    # amlint: disable=AM105 — tiny fixed-size table, not per-row work
+    out.sort(key=lambda v: -v)
+    return out

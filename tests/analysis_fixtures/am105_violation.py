@@ -1,0 +1,18 @@
+# amlint: hot-path — fixture: per-row assembly anti-patterns (AM105)
+
+
+def slot_rows(ops, actions, visible, lamport):
+    """The old row-at-a-time assembly shape: coerce every row, then sort
+    with a per-element Python callback."""
+    out = []
+    for i in range(len(ops)):
+        out.append((int(ops[i]), bool(visible[i]), actions[i]))
+    out.sort(key=lambda r: lamport(r[0]))
+    return out
+
+
+def winner_totals(totals, rows):
+    return sorted(
+        [int(totals[i]) for i in range(len(rows))],
+        key=lambda t: (t, 0),
+    )

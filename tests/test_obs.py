@@ -350,9 +350,11 @@ def test_farm_and_engine_metrics_count_real_work():
     assert reg.gauge("farm.pad_waste_ratio").value == 0.0
     assert reg.histogram("farm.batch.occupancy").count == 2
     assert reg.counter("farm.changes.applied").value == 10
-    # each call dispatches one merge + one visibility program
+    # each call dispatches one merge, one (version-memoised) visibility
+    # program, and one scoped readback gather — never more, however many
+    # docs/slots need patches
     dispatches = reg.counter("engine.device.dispatches").value
-    assert dispatches == 4
+    assert dispatches == 6
     hits = reg.counter("engine.jit.cache_hits").value
     recompiles = reg.counter("engine.jit.recompiles").value
     assert hits + recompiles == dispatches

@@ -1,0 +1,228 @@
+"""Parity suite for the incremental visibility readback + vectorized patch
+assembly (ISSUE 4).
+
+The farm's patch contract is defined by the sequential reference walk
+(OpSet): whatever the host mirror caches, however little the scoped
+readback transfers, and however the assembly masks are computed, every
+patch must stay BYTE-IDENTICAL (asserted via canonical JSON, stricter than
+dict equality) to the walk's output — across random fuzz workloads, across
+quarantine/rollback interleavings from the fault corpus (the visibility
+cache must be invalidated on rollback), and across device-failure fallback
+interleavings. A separate invariant test pins the host row mirror to the
+device state via the retained full-readback path (_read_visibility).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from automerge_tpu.opset import OpSet
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+from test_farm import Workload
+
+SEEDS = [11, 23, 47]
+ROUNDS = 10
+
+
+def canon(patch):
+    """Canonical bytes of a patch: nested child patches are plain dicts, so
+    sorted-key JSON is a byte-exact representation."""
+    return json.dumps(patch, sort_keys=True)
+
+
+def assert_patch_equal(got, want, context=""):
+    assert canon(got) == canon(want), (
+        f"{context}: patch diverged from the reference walk\n"
+        f"got:  {canon(got)}\nwant: {canon(want)}"
+    )
+
+
+def run_workload(seed, num_docs=3, rounds=ROUNDS, deliver=None):
+    """Drives `num_docs` copies of one random workload through a farm and
+    per-doc OpSet oracles, asserting per-call patch parity. `deliver` can
+    rewrite the per-doc delivery (fault interleavings)."""
+    farm = TpuDocFarm(num_docs, capacity=64, quarantine_threshold=None)
+    oracles = [OpSet() for _ in range(num_docs)]
+    workload = Workload(seed)
+    for r in range(rounds):
+        # the oracle state BEFORE delivery drives generation (test_farm)
+        buffers = workload.next_round(oracles[0])
+        if not buffers:
+            continue
+        per_doc = [list(buffers) for _ in range(num_docs)]
+        if deliver is not None:
+            per_doc = deliver(r, per_doc)
+        patches = farm.apply_changes(per_doc)
+        for d in range(num_docs):
+            want = oracles[d].apply_changes(list(per_doc[d]))
+            assert_patch_equal(
+                patches[d], want, f"seed={seed} round={r} doc={d}"
+            )
+    for d in range(num_docs):
+        assert_patch_equal(
+            farm.get_patch(d), oracles[d].get_patch(),
+            f"seed={seed} whole-doc doc={d}",
+        )
+    return farm, oracles
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_corpus_patch_parity(seed):
+    """Random map-family workloads (concurrent actors, counters, nesting,
+    deletes, delayed delivery): every incremental patch and the final
+    whole-doc patch are byte-identical to the reference walk."""
+    run_workload(seed)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_mirror_matches_device_state(seed):
+    """The host row mirror IS the device op table: keys/opIds/actions match
+    the full-state readback row for row, and the refreshed visible/total
+    cache matches the device visibility program for every live row."""
+    farm, _ = run_workload(seed)
+    keys, ops, visible, totals, actions = farm._read_visibility()
+    for d in range(farm.num_docs):
+        farm._refresh_visibility([d])
+        n = farm._vis_mkey[d].shape[0]
+        assert int((np.asarray(keys[d]) != np.iinfo(np.int32).max).sum()) == n
+        np.testing.assert_array_equal(farm._vis_key[d], keys[d][:n])
+        np.testing.assert_array_equal(farm._vis_op[d], ops[d][:n])
+        np.testing.assert_array_equal(farm._vis_action[d], actions[d][:n])
+        np.testing.assert_array_equal(farm._vis_visible[d], visible[d][:n])
+        np.testing.assert_array_equal(farm._vis_total[d], totals[d][:n])
+
+
+@pytest.mark.parametrize("name,corrupt,kind", faults.BYTE_CORPUS)
+def test_quarantine_rollback_keeps_parity(name, corrupt, kind):
+    """A poisoned delivery quarantines one doc (state rolled back, cache
+    invalidated); subsequent clean deliveries to that doc must still
+    produce byte-identical patches — stale cached visibility after the
+    rollback would diverge here."""
+    poison_round, poison_doc = 3, 1
+
+    def deliver(r, per_doc):
+        if r == poison_round and per_doc[poison_doc]:
+            per_doc[poison_doc] = [
+                bytes(corrupt(buf)) for buf in per_doc[poison_doc]
+            ]
+        return per_doc
+
+    num_docs = 3
+    farm = TpuDocFarm(num_docs, capacity=64, quarantine_threshold=None)
+    oracles = [OpSet() for _ in range(num_docs)]
+    workload = Workload(7)
+    saw_quarantine = False
+    for r in range(ROUNDS):
+        buffers = workload.next_round(oracles[0])
+        if not buffers:
+            continue
+        per_doc = deliver(r, [list(buffers) for _ in range(num_docs)])
+        patches = farm.apply_changes(per_doc)
+        for d in range(num_docs):
+            if patches.outcomes[d].status == "quarantined":
+                saw_quarantine = True
+                assert d == poison_doc and r == poison_round
+                continue  # oracle does not see the poisoned delivery
+            want = oracles[d].apply_changes(list(per_doc[d]))
+            assert_patch_equal(patches[d], want, f"{name} round={r} doc={d}")
+    # the poisoned doc diverges from its oracle only by the dropped
+    # delivery; both must agree on their own full state
+    for d in range(num_docs):
+        if d == poison_doc and saw_quarantine:
+            continue
+        assert_patch_equal(farm.get_patch(d), oracles[d].get_patch(), name)
+
+
+def test_gate_rollback_mid_batch_keeps_parity():
+    """A causality fault AFTER earlier changes of the same call committed
+    exercises the deepest rollback (partial gate commit + mirror-adjacent
+    state): the visibility cache must be invalidated with it."""
+    farm = TpuDocFarm(2, capacity=64, quarantine_threshold=None)
+    oracle = OpSet()
+
+    import automerge_tpu.columnar as col
+
+    a1 = faults.make_change("aa" * 4, 1, 1, [], [faults.set_op("k", 1)])
+    farm.apply_changes([[a1], [a1]])
+    oracle.apply_changes([a1])
+    h1 = col.decode_change_columns(a1)["hash"]
+    a2 = faults.make_change("aa" * 4, 2, 2, [h1], [faults.set_op("k", 2)])
+    a2_dup_seq = faults.make_change(
+        "aa" * 4, 2, 3, [col.decode_change_columns(a2)["hash"]],
+        [faults.set_op("k", 3)],
+    )
+    # doc 0: valid a2 then seq-reuse -> whole delivery rolls back
+    result = farm.apply_changes([[a2, a2_dup_seq], [a2]])
+    assert result.outcomes[0].status == "quarantined"
+    assert result.outcomes[1].status == "applied"
+    want = oracle.apply_changes([a2])
+    assert_patch_equal(result[1], want, "doc 1 beside a rollback")
+    # doc 0 state must equal the pre-call state (a1 only)
+    pre = OpSet()
+    pre.apply_changes([a1])
+    assert_patch_equal(farm.get_patch(0), pre.get_patch(), "rolled-back doc")
+    # and a clean retry of a2 lands byte-identically
+    retry = farm.apply_changes([[a2], []])
+    assert_patch_equal(retry[0], want, "retry after rollback")
+
+
+def test_device_failure_fallback_interleaving_keeps_parity():
+    """Mid-stream device failure: the poisoned doc quarantines, survivors
+    fall back to the walk for that call, and every later call (device
+    healthy again) stays byte-identical — including whole-doc reads."""
+    num_docs = 4
+    farm = TpuDocFarm(num_docs, capacity=64, quarantine_threshold=None)
+    oracles = [OpSet() for _ in range(num_docs)]
+    workload = Workload(13)
+    for r in range(ROUNDS):
+        buffers = workload.next_round(oracles[0])
+        if not buffers:
+            continue
+        per_doc = [list(buffers) for _ in range(num_docs)]
+        if r == 4:
+            with faults.inject("farm.device_dispatch", faults.fail_docs([2])):
+                patches = farm.apply_changes(per_doc)
+        else:
+            patches = farm.apply_changes(per_doc)
+        for d in range(num_docs):
+            if patches.outcomes[d].status == "quarantined":
+                assert r == 4 and d == 2
+                continue
+            want = oracles[d].apply_changes(list(per_doc[d]))
+            assert_patch_equal(patches[d], want, f"round={r} doc={d}")
+    for d in range(num_docs):
+        if d == 2:
+            continue
+        assert_patch_equal(
+            farm.get_patch(d), oracles[d].get_patch(), f"whole-doc {d}"
+        )
+
+
+def test_decode_cache_shares_parses_not_state():
+    """One buffer fanned to N docs is decoded once, but each doc's gate/
+    state stays independent: byte-identical patches for every doc, and the
+    cache survives duplicate (no-op) redelivery."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+
+    num_docs = 8
+    farm = TpuDocFarm(num_docs, capacity=32)
+    oracles = [OpSet() for _ in range(num_docs)]
+    a1 = faults.make_change("bb" * 4, 1, 1, [], [faults.set_op("x", 41)])
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        patches = farm.apply_changes([[a1]] * num_docs)
+        for d in range(num_docs):
+            want = oracles[d].apply_changes([a1])
+            assert_patch_equal(patches[d], want, f"fanout doc={d}")
+        # duplicate redelivery is a no-op for every doc
+        dup = farm.apply_changes([[a1]] * num_docs)
+        for d in range(num_docs):
+            want = oracles[d].apply_changes([a1])
+            assert_patch_equal(dup[d], want, f"duplicate doc={d}")
+    hits = reg.counter("codecs.decode_cache.hits").value
+    misses = reg.counter("codecs.decode_cache.misses").value
+    assert hits >= 2 * num_docs - 1 - misses
+    assert misses <= 1
