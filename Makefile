@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke
+.PHONY: lint test native obs-report faults bench-smoke chaos
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -17,6 +17,13 @@ test:
 # curve with N% poison docs: `python bench.py --faults N`.
 faults:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q
+
+# the chaos soak suite (incl. slow sweeps): supervised sync convergence
+# under seeded loss/dup/reorder/corruption, peer restarts, partitions
+# (tests/test_chaos_sync.py + the session unit suite). Goodput vs loss:
+# `python bench.py --chaos P`.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos_sync.py tests/test_sync_session.py -q
 
 # host perf gate: fails when the visibility+patch_assembly share of
 # end-to-end time regresses above BENCH_SMOKE_MAX_TAIL_SHARE (README

@@ -16,14 +16,19 @@ from .columnar import decode_change, encode_change
 from .errors import (
     AutomergeError,
     CausalityError,
+    ChannelQuarantinedError,
     ChecksumError,
     DecodeError,
     DeviceFaultError,
     EncodeError,
     PackingLimitError,
     QuarantinedError,
+    RetryExhaustedError,
+    SyncFrameError,
     SyncProtocolError,
 )
+from .sync import decode_sync_state, encode_sync_state
+from .sync_session import BackendDriver, SessionConfig, SyncSession
 from .frontend import (
     Counter,
     Float64,
@@ -52,12 +57,15 @@ __all__ = [
     "encode_change", "decode_change", "equals", "get_history", "uuid",
     "Frontend", "set_default_backend", "get_backend",
     "generate_sync_message", "receive_sync_message", "init_sync_state",
+    "encode_sync_state", "decode_sync_state",
+    "SyncSession", "SessionConfig", "BackendDriver",
     "get_object_id", "get_object_by_id", "get_actor_id", "set_actor_id",
     "get_conflicts", "get_last_local_change", "get_element_ids",
     "Text", "Table", "Counter", "Observable", "Int", "Uint", "Float64",
     "Map", "List",
     "AutomergeError", "DecodeError", "ChecksumError", "EncodeError",
     "CausalityError", "PackingLimitError", "SyncProtocolError",
+    "SyncFrameError", "RetryExhaustedError", "ChannelQuarantinedError",
     "QuarantinedError", "DeviceFaultError",
 ]
 

@@ -59,6 +59,9 @@ RULES: dict[str, tuple[str, str]] = {
     "AM401": ("taxonomy", "bare ValueError/TypeError raised in a data-plane "
                           "module (raise a classifiable taxonomy error from "
                           "automerge_tpu.errors)"),
+    "AM402": ("taxonomy", "direct wall-clock/sleep/global-RNG call "
+                          "(time.time/time.sleep/random.*) in a sync "
+                          "data-plane module (inject a clock/RNG instead)"),
 }
 
 _SUPPRESS_RE = re.compile(

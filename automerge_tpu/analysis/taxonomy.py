@@ -1,4 +1,4 @@
-"""AM401 — error-taxonomy hygiene: data-plane modules raise classifiable errors.
+"""AM401/AM402 — data-plane hygiene: classifiable errors, injectable time.
 
 The fault-isolation layer (tpu/farm.py) routes per-document failures by
 taxonomy class (automerge_tpu/errors.py): ``DecodeError`` means re-request
@@ -18,6 +18,18 @@ scope — their errors face the local programmer, not untrusted traffic.
 Deliberate bare raises (argument-type validation, API-usage errors,
 internal invariants that indicate a bug rather than bad input) stay bare
 with a justified ``# amlint: disable=AM401`` suppression.
+
+AM402 guards the *time* axis of the same determinism story: the sync
+supervision layer (sync_session.py) has retransmission timeouts, backoff
+jitter and a watchdog — the first time-based control flow in the stack.
+A direct ``time.time()``/``time.sleep()``/``random.random()`` call in a
+sync data-plane module makes that control flow unreplayable (the chaos
+soak suite cannot reproduce a failure schedule) and couples tests to wall
+clocks. Those modules (``SYNC_DATA_PLANE_STEMS``, plus files marked
+``# amlint: sync-data-plane``) must take an injected clock callable and a
+``random.Random`` instance; constructing an RNG (``random.Random(seed)``,
+``random.SystemRandom()``) is allowed — that *is* the injection point —
+and the one real-time default carries a justified suppression.
 """
 from __future__ import annotations
 
@@ -25,18 +37,38 @@ import ast
 import re
 from pathlib import Path
 
-from .core import FileContext, Finding
+from .core import FileContext, Finding, dotted_name
 
 #: data-plane module stems the rule applies to
 DATA_PLANE_STEMS = frozenset({
     "codecs", "columnar", "opset", "sync", "farm", "rga",
-    "sync_farm", "sync_batch", "transcode", "engine", "text_engine",
+    "sync_farm", "sync_batch", "sync_session", "transcode", "engine",
+    "text_engine",
 })
 
 _MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")
 
 #: the stdlib classes whose bare raise loses the error_kind dimension
 _BARE = {"ValueError", "TypeError"}
+
+#: sync data-plane module stems AM402 applies to (the modules whose
+#: control flow the chaos suite must be able to replay deterministically)
+SYNC_DATA_PLANE_STEMS = frozenset({
+    "sync", "sync_session", "sync_farm", "sync_batch",
+})
+
+_SYNC_MARKER_RE = re.compile(r"#\s*amlint:\s*sync-data-plane")
+
+#: wall-clock reads and sleeps that make supervised control flow
+#: unreplayable (call sites must take an injected clock instead)
+_CLOCK_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.sleep", "time.monotonic",
+    "time.monotonic_ns", "time.perf_counter", "time.perf_counter_ns",
+})
+
+#: random.* attributes that are NOT the module-global RNG: constructing an
+#: instance is the injection pattern the rule demands
+_RNG_CONSTRUCTORS = frozenset({"Random", "SystemRandom"})
 
 
 def _in_scope(ctx: FileContext) -> bool:
@@ -46,9 +78,64 @@ def _in_scope(ctx: FileContext) -> bool:
     )
 
 
+def _in_sync_scope(ctx: FileContext) -> bool:
+    return (
+        Path(ctx.path).stem in SYNC_DATA_PLANE_STEMS
+        or _SYNC_MARKER_RE.search(ctx.source) is not None
+    )
+
+
+def _time_imports(tree: ast.Module) -> set[str]:
+    """Local names bound by ``from time import ...``/``from random import
+    ...`` to the banned callables (so aliased direct calls are caught)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or node.module not in (
+            "time", "random"
+        ):
+            continue
+        for alias in node.names:
+            if node.module == "time":
+                if f"time.{alias.name}" in _CLOCK_CALLS:
+                    names.add(alias.asname or alias.name)
+            elif alias.name not in _RNG_CONSTRUCTORS:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _check_am402(ctx: FileContext, findings: list[Finding]) -> None:
+    aliased = _time_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        banned = (
+            name in _CLOCK_CALLS
+            or (
+                name.startswith("random.")
+                and name.split(".", 1)[1] not in _RNG_CONSTRUCTORS
+            )
+            or name in aliased
+        )
+        if banned:
+            findings.append(ctx.finding(
+                "AM402", node,
+                f"direct {name}() call in a sync data-plane module: "
+                "retransmission timeouts, backoff jitter and watchdog "
+                "decisions must be driven by an injected clock callable "
+                "and random.Random instance so the chaos suite can replay "
+                "them deterministically; suppress with a justification at "
+                "the single real-time default",
+            ))
+
+
 def check(ctxs: list[FileContext]) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
+        if _in_sync_scope(ctx):
+            _check_am402(ctx, findings)
         if not _in_scope(ctx):
             continue
         for node in ast.walk(ctx.tree):

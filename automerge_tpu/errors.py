@@ -23,6 +23,9 @@ Hierarchy::
     ├── CausalityError(ValueError)     seq reuse/skip, unknown pred/dep/ref
     ├── PackingLimitError(ValueError)  merge-key / MAX_ELEMS / interner caps
     ├── SyncProtocolError(ValueError)  malformed or inapplicable peer message
+    │   ├── SyncFrameError             malformed session envelope (outer framing)
+    │   ├── RetryExhaustedError        retransmission budget spent; channel quarantined
+    │   └── ChannelQuarantinedError    traffic shed: the sync channel is quarantined
     └── QuarantinedError               delivery shed: the doc is quarantined
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
@@ -73,6 +76,30 @@ class SyncProtocolError(AutomergeError, ValueError):
     state is left untouched by the rejecting call."""
 
     kind = "sync"
+
+
+class SyncFrameError(SyncProtocolError):
+    """A session envelope (the outer seq/ack framing added by
+    ``automerge_tpu.sync_session``) that is structurally invalid or fails
+    its checksum; the inner reference wire format never saw the bytes and
+    session state is untouched."""
+
+    kind = "sync_frame"
+
+
+class RetryExhaustedError(SyncProtocolError):
+    """A supervised sync channel spent its full retransmission budget
+    without an acknowledgement; the channel (not the document) is
+    quarantined until ``SyncSession.release()``."""
+
+    kind = "sync_retry"
+
+
+class ChannelQuarantinedError(SyncProtocolError):
+    """Traffic shed without processing: the sync channel is quarantined
+    (see ``SyncSession.release``); the peer pair's documents stay live."""
+
+    kind = "sync_quarantined"
 
 
 class DeviceFaultError(AutomergeError):

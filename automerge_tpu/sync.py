@@ -200,23 +200,73 @@ def decode_sync_message(data):
     return message
 
 
-def encode_sync_state(sync_state) -> bytes:
+#: version tag of the optional session-supervision extension appended after
+#: sharedHeads by encode_sync_state(..., session=...). Pre-extension blobs
+#: simply end after the hashes; pre-extension decoders ignore trailing
+#: bytes, so the formats are compatible in both directions.
+SESSION_EXT_VERSION = 1
+
+
+def encode_sync_state(sync_state, session=None) -> bytes:
     """Persists the durable part of a peer state (sharedHeads only; the
-    ephemeral fields are deliberately dropped, sync.js:206)."""
+    ephemeral fields are deliberately dropped, sync.js:206).
+
+    `session`, when given, is the supervision envelope persisted by
+    ``SyncSession.save()`` — ``{"epoch", "seqOut", "lastSeen",
+    "peerEpoch"}`` — appended as a versioned extension block that old
+    decoders skip as trailing bytes."""
     encoder = Encoder()
     encoder.append_byte(PEER_STATE_TYPE)
     _encode_hashes(encoder, sync_state["sharedHeads"])
+    if session is not None:
+        encoder.append_byte(SESSION_EXT_VERSION)
+        encoder.append_uint32(session["epoch"])
+        encoder.append_uint53(session["seqOut"])
+        encoder.append_uint53(session["lastSeen"])
+        peer_epoch = session.get("peerEpoch")
+        encoder.append_byte(0 if peer_epoch is None else 1)
+        encoder.append_uint32(peer_epoch or 0)
     return encoder.buffer
 
 
 def decode_sync_state(data):
-    decoder = Decoder(data)
-    record_type = decoder.read_byte()
-    if record_type != PEER_STATE_TYPE:
-        raise SyncProtocolError(f"Unexpected record type: {record_type}")
-    shared_heads = _decode_hashes(decoder)
+    """Restores a persisted peer state. Truncated or garbage bytes raise
+    ``SyncProtocolError`` (never a raw ``IndexError``/``DecodeError``) and
+    construct no partial state. A blob carrying the session extension
+    yields a ``"session"`` key (consumed by ``SyncSession.restore``);
+    pre-extension blobs decode exactly as before."""
+    try:
+        decoder = Decoder(data)
+        record_type = decoder.read_byte()
+        if record_type != PEER_STATE_TYPE:
+            raise SyncProtocolError(f"Unexpected record type: {record_type}")
+        shared_heads = _decode_hashes(decoder)
+        session = None
+        if not decoder.done:
+            version = decoder.read_byte()
+            if version != SESSION_EXT_VERSION:
+                raise SyncProtocolError(
+                    f"Unknown sync-state session extension version: {version}"
+                )
+            epoch = decoder.read_uint32()
+            seq_out = decoder.read_uint53()
+            last_seen = decoder.read_uint53()
+            peer_known = decoder.read_byte()
+            peer_epoch = decoder.read_uint32()
+            session = {
+                "epoch": epoch,
+                "seqOut": seq_out,
+                "lastSeen": last_seen,
+                "peerEpoch": peer_epoch if peer_known else None,
+            }
+    except SyncProtocolError:
+        raise
+    except (ValueError, TypeError, IndexError) as exc:
+        raise SyncProtocolError(f"malformed sync state: {exc}") from exc
     state = init_sync_state()
     state["sharedHeads"] = shared_heads
+    if session is not None:
+        state["session"] = session
     return state
 
 

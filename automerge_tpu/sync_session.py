@@ -1,0 +1,528 @@
+"""Session supervision for the sync protocol: reliable delivery over lossy
+transports.
+
+The reference Bloom-filter protocol (automerge_tpu/sync.py, backend/sync.js)
+is specified over a reliable, in-order, exactly-once transport. This module
+supplies that transport contract on top of an unreliable one: each
+``SyncSession`` supervises one peer channel, wrapping
+``generate_sync_message``/``receive_sync_message`` in a compact outer
+envelope — the inner payload stays the reference wire format, byte for
+byte — that adds:
+
+- **sequence numbers + acks** (stop-and-wait): duplicate and stale frames
+  are idempotent no-ops, counted on ``sync.session.dup_dropped``;
+- **timeout + bounded retransmission** with exponential backoff and full
+  jitter, driven by an *injectable* clock and RNG (amlint AM402 bans
+  ``time.time``/``random.*`` from the sync data plane);
+- **channel quarantine** after the retry budget is exhausted — the channel
+  is shed, mirroring the doc farm's quarantine lifecycle (PR 3), while the
+  documents stay live;
+- **peer-restart detection**: every session carries a random ``epoch``; a
+  peer that comes back with a new epoch gets a clean re-handshake (seq
+  tracking reset, our beliefs about the peer dropped) instead of a
+  permanent heads mismatch;
+- **a convergence watchdog**: no head/sharedHeads progress across K
+  supervised rounds while payload frames still flow escalates — first a
+  Bloom-filter rebuild (clear ``sentHashes``/``lastSentHeads``, resending
+  anything wrongly withheld, e.g. after a pathological Bloom
+  false-positive loop), then a full reset exchange (``sharedHeads = []``
+  and the peer's filter treated as empty, so everything is offered
+  explicitly).
+
+Sessions persist through the existing ``encode_sync_state`` path:
+``save()`` appends a versioned extension block (epoch/seq/ack watermarks)
+that pre-extension decoders skip, and ``restore()`` resumes a channel
+mid-sync after a process restart.
+
+Frame layout (outer framing only; ``FRAME_TYPE`` is disjoint from the
+``MESSAGE_TYPE_SYNC``/``PEER_STATE_TYPE`` record space)::
+
+    byte  FRAME_TYPE (0x44)
+    4B    checksum = sha256(body)[:4]     (rejects in-flight corruption)
+    body: uint32 epoch | uint53 seq | uint53 ack | byte flags
+          [prefixed payload when flags & FLAG_PAYLOAD]
+
+``seq`` is 0 on ack-only frames (they carry no payload and are never
+retransmitted); payload frames use a monotonic per-session sequence.
+"""
+# amlint: host-only — pure-host layer: must not import tpu/ or jax
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from hashlib import sha256
+
+from . import backend as Backend
+from .codecs import Decoder, Encoder
+from .errors import (
+    ChannelQuarantinedError,
+    RetryExhaustedError,
+    SyncFrameError,
+    SyncProtocolError,
+)
+from .obs.metrics import get_metrics
+from .sync import (
+    decode_sync_message,
+    decode_sync_state,
+    encode_sync_state,
+    generate_sync_message,
+    init_sync_state,
+    receive_sync_message,
+)
+from .testing.faults import fire as _fault_point
+
+FRAME_TYPE = 0x44
+FLAG_PAYLOAD = 0x01
+
+_CHECKSUM_SIZE = 4
+
+_METRICS = get_metrics()
+_M_RETRANSMITS = _METRICS.counter(
+    "sync.session.retransmits", "payload frames retransmitted after a timeout"
+)
+_M_DUP_DROPPED = _METRICS.counter(
+    "sync.session.dup_dropped",
+    "duplicate/stale frames dropped idempotently (re-acked, not applied)",
+)
+_M_TIMEOUTS = _METRICS.counter(
+    "sync.session.timeouts", "retransmission deadlines that expired unacked"
+)
+_M_BACKOFF_MS = _METRICS.histogram(
+    "sync.session.backoff_ms",
+    "full-jitter backoff delays (ms) applied before retransmissions",
+)
+_M_PEER_RESTARTS = _METRICS.counter(
+    "sync.session.peer_restarts",
+    "epoch changes observed from the peer (clean re-handshakes triggered)",
+)
+_M_FRAMES_REJECTED = _METRICS.counter(
+    "sync.session.frames_rejected",
+    "frames dropped as malformed/corrupt (SyncFrameError; state untouched)",
+)
+_M_SHED = _METRICS.counter(
+    "sync.session.shed",
+    "frames shed unprocessed because the channel is quarantined",
+)
+_M_WD_STALLS = _METRICS.counter(
+    "sync.watchdog.stalls",
+    "stalled-pair detections (no head progress while messages flowed)",
+)
+_M_WD_ESCALATIONS = _METRICS.counter(
+    "sync.watchdog.escalations",
+    "watchdog escalations (Bloom rebuild, then full reset exchange)",
+)
+_M_WD_RESETS = _METRICS.counter(
+    "sync.watchdog.resets",
+    "full reset exchanges forced after a Bloom rebuild failed to unstall",
+)
+_M_CHQ_ENTERED = _METRICS.counter(
+    "sync.channel.quarantine.entered",
+    "channels quarantined after the retransmission budget was exhausted",
+)
+_M_CHQ_RELEASED = _METRICS.counter(
+    "sync.channel.quarantine.released", "channels returned to service"
+)
+_M_CHQ_ACTIVE = _METRICS.gauge(
+    "sync.channel.quarantine.active", "channels currently quarantined"
+)
+
+_active_quarantined = 0
+
+
+# ---------------------------------------------------------------------- #
+# frame codec (outer framing only; payload is the reference wire format)
+
+def encode_frame(epoch: int, seq: int, ack: int, payload: bytes | None) -> bytes:
+    body = Encoder()
+    body.append_uint32(epoch)
+    body.append_uint53(seq)
+    body.append_uint53(ack)
+    if payload is None:
+        body.append_byte(0)
+    else:
+        body.append_byte(FLAG_PAYLOAD)
+        body.append_prefixed_bytes(payload)
+    encoder = Encoder()
+    encoder.append_byte(FRAME_TYPE)
+    encoder.append_raw_bytes(sha256(body.buffer).digest()[:_CHECKSUM_SIZE])
+    encoder.append_raw_bytes(body.buffer)
+    return encoder.buffer
+
+
+def decode_frame(data) -> dict:
+    """Decodes one session frame; raises ``SyncFrameError`` on any
+    malformed or corrupted input (short reads, checksum mismatch, bad
+    type), never a raw decode exception, and touches no session state."""
+    try:
+        decoder = Decoder(data)
+        frame_type = decoder.read_byte()
+        if frame_type != FRAME_TYPE:
+            raise SyncFrameError(f"unexpected frame type: {frame_type}")
+        checksum = decoder.read_raw_bytes(_CHECKSUM_SIZE)
+        body = decoder.read_raw_bytes(len(decoder.buf) - decoder.offset)
+        if sha256(body).digest()[:_CHECKSUM_SIZE] != checksum:
+            raise SyncFrameError("session frame checksum mismatch")
+        body_decoder = Decoder(body)
+        epoch = body_decoder.read_uint32()
+        seq = body_decoder.read_uint53()
+        ack = body_decoder.read_uint53()
+        flags = body_decoder.read_byte()
+        payload = (
+            body_decoder.read_prefixed_bytes() if flags & FLAG_PAYLOAD else None
+        )
+    except SyncFrameError:
+        raise
+    except (ValueError, TypeError, IndexError) as exc:
+        raise SyncFrameError(f"malformed session frame: {exc}") from exc
+    return {"epoch": epoch, "seq": seq, "ack": ack, "payload": payload}
+
+
+# ---------------------------------------------------------------------- #
+# protocol drivers: what a session supervises
+
+class BackendDriver:
+    """Supervises a backend handle via the sequential protocol
+    (automerge_tpu/sync.py). The handle is rebound on every receive, so the
+    session owns the document's latest state."""
+
+    def __init__(self, backend):
+        self.backend = backend
+
+    def generate(self, state):
+        return generate_sync_message(self.backend, state)
+
+    def receive(self, state, payload):
+        self.backend, state, patch = receive_sync_message(
+            self.backend, state, payload
+        )
+        return state, patch
+
+    def heads(self):
+        return Backend.get_heads(self.backend)
+
+
+class FarmDriver:
+    """Supervises one document channel of a batched ``SyncFarm``
+    (tpu/sync_farm.py). Malformed payloads raise out of ``receive`` (the
+    session must withhold its ack so the peer retransmits), so the inner
+    message is validated here before the farm's reject-in-place path."""
+
+    def __init__(self, sync_farm, doc: int):
+        self.sync_farm = sync_farm
+        self.doc = doc
+
+    def generate(self, state):
+        ((state, msg),) = self.sync_farm.generate_messages([(self.doc, state)])
+        return state, msg
+
+    def receive(self, state, payload):
+        decode_sync_message(payload)  # raises SyncProtocolError, state untouched
+        ((state, patch),) = self.sync_farm.receive_messages(
+            [(self.doc, state, payload)]
+        )
+        return state, patch
+
+    def heads(self):
+        return self.sync_farm.farm.get_heads(self.doc)
+
+
+# ---------------------------------------------------------------------- #
+
+@dataclass
+class SessionConfig:
+    """Supervision knobs. Times are in the injected clock's units
+    (seconds under the default monotonic clock)."""
+
+    timeout: float = 1.0          # unacked-frame deadline before retransmit
+    max_retries: int = 8          # retransmissions before channel quarantine
+    backoff_base: float = 0.5     # first retry's backoff cap
+    backoff_cap: float = 10.0     # backoff growth ceiling
+    watchdog_rounds: int = 5      # K no-progress rounds before escalation
+
+
+def _default_clock():
+    # the single wall-clock injection point for the sync data plane; every
+    # other call site takes this (or a test clock) as a parameter
+    return time.monotonic()  # amlint: disable=AM402 — the injectable-clock default
+
+
+class SyncSession:
+    """One supervised peer channel. Drive it with two calls:
+
+    - ``poll()`` — the send half: returns the next frame to transmit (a
+      fresh payload frame, a retransmission, or an owed ack), or None.
+    - ``handle(frame)`` — the receive half: processes one incoming frame,
+      returns the patch from the inner protocol (or None for acks,
+      duplicates and shed frames). Corrupt frames raise ``SyncFrameError``
+      (and inapplicable payloads ``SyncProtocolError``) with all session
+      state untouched, so the peer's retransmission gets a clean retry.
+
+    ``clock`` is a zero-argument callable; ``rng`` is a ``random.Random``
+    instance. Both default to real time / OS entropy but are injectable so
+    tests and the chaos harness are fully deterministic.
+    """
+
+    def __init__(self, driver, *, clock=None, rng=None, config=None,
+                 state=None):
+        self.driver = driver
+        self.clock = clock if clock is not None else _default_clock
+        self.rng = rng if rng is not None else random.Random()
+        self.config = config or SessionConfig()
+        self.state = state if state is not None else init_sync_state()
+        self.epoch = self.rng.getrandbits(32) or 1  # 0 is reserved: "unknown"
+        self.seq_out = 0          # last payload sequence number used
+        self.last_seen = 0        # highest peer payload seq applied
+        self.peer_epoch = None
+        self.pending = None       # unacked outgoing payload frame, or None
+        self.ack_owed = False
+        self.quarantine_cause = None
+        self.stats = {
+            "retransmits": 0, "dup_dropped": 0, "timeouts": 0,
+            "backoff_ms": 0.0, "peer_restarts": 0, "shed": 0,
+            "stalls": 0, "escalations": 0, "resets": 0,
+        }
+        self._wd_heads = None
+        self._wd_shared = None
+        self._wd_rounds = 0
+        self._wd_stage = 0
+
+    # -------------------------------------------------------------- #
+    # send half
+
+    def poll(self):
+        """Returns the next frame to transmit, or None when idle. Call it
+        whenever the transport can send: it retransmits on expired
+        deadlines, generates the next protocol message when the channel is
+        clear, and emits owed acks."""
+        if self.quarantine_cause is not None:
+            return None
+        now = self.clock()
+        if self.pending is not None:
+            if now < self.pending["deadline"]:
+                return self._ack_frame() if self.ack_owed else None
+            _M_TIMEOUTS.inc()
+            self.stats["timeouts"] += 1
+            if self.pending["attempt"] >= self.config.max_retries:
+                self._enter_quarantine(RetryExhaustedError(
+                    f"no ack for frame seq={self.pending['seq']} after "
+                    f"{self.pending['attempt']} retransmissions; channel "
+                    "quarantined (release() to retry)"
+                ))
+                return None
+            self.pending["attempt"] += 1
+            self.pending["deadline"] = (
+                now + self.config.timeout + self._backoff(self.pending["attempt"])
+            )
+            _M_RETRANSMITS.inc()
+            self.stats["retransmits"] += 1
+            self.ack_owed = False
+            # re-frame so the retransmission carries the current ack
+            return encode_frame(
+                self.epoch, self.pending["seq"], self.last_seen,
+                self.pending["payload"],
+            )
+        state, payload = self.driver.generate(self.state)
+        self.state = state
+        if payload is None:
+            return self._ack_frame() if self.ack_owed else None
+        self.seq_out += 1
+        self.pending = {
+            "seq": self.seq_out,
+            "payload": payload,
+            "attempt": 0,
+            "deadline": now + self.config.timeout,
+        }
+        self.ack_owed = False
+        return encode_frame(self.epoch, self.seq_out, self.last_seen, payload)
+
+    def _ack_frame(self) -> bytes:
+        self.ack_owed = False
+        return encode_frame(self.epoch, 0, self.last_seen, None)
+
+    def _backoff(self, attempt: int) -> float:
+        """Full jitter: uniform in [0, min(cap, base * 2^(attempt-1)))."""
+        ceiling = min(
+            self.config.backoff_cap,
+            self.config.backoff_base * (2 ** (attempt - 1)),
+        )
+        delay = self.rng.uniform(0.0, ceiling)
+        _M_BACKOFF_MS.observe(delay * 1000.0)
+        self.stats["backoff_ms"] += delay * 1000.0
+        return delay
+
+    # -------------------------------------------------------------- #
+    # receive half
+
+    def handle(self, frame_bytes):
+        """Processes one incoming frame; returns the inner protocol's patch
+        (None for acks/duplicates/shed frames)."""
+        if self.quarantine_cause is not None:
+            _M_SHED.inc()
+            self.stats["shed"] += 1
+            return None
+        _fault_point("session.receive", frame=frame_bytes)
+        try:
+            frame = decode_frame(frame_bytes)
+        except SyncFrameError:
+            _M_FRAMES_REJECTED.inc()
+            raise
+        if frame["epoch"] != self.peer_epoch:
+            if self.peer_epoch is not None:
+                self._on_peer_restart()
+            self.peer_epoch = frame["epoch"]
+        if self.pending is not None and frame["ack"] >= self.pending["seq"]:
+            self.pending = None
+        payload = frame["payload"]
+        if payload is None:
+            return None
+        if frame["seq"] <= self.last_seen:
+            _M_DUP_DROPPED.inc()
+            self.stats["dup_dropped"] += 1
+            self.ack_owed = True  # re-ack so the peer stops retransmitting
+            return None
+        # apply BEFORE advancing the seq watermark: a payload the inner
+        # protocol rejects (corrupt/inapplicable) must not be acked, so the
+        # peer's intact retransmission gets a clean retry
+        state, patch = self.driver.receive(self.state, payload)
+        self.state = state
+        self.last_seen = frame["seq"]
+        self.ack_owed = True
+        self._watchdog_round()
+        return patch
+
+    def _on_peer_restart(self):
+        """The peer came back with a new epoch: reset the envelope-level
+        seq tracking and drop everything we believed about the peer, so
+        the next exchange is a clean re-handshake (the inner protocol's
+        reset paths then re-establish sharedHeads) instead of a permanent
+        dup-drop/heads mismatch."""
+        _M_PEER_RESTARTS.inc()
+        self.stats["peer_restarts"] += 1
+        self.last_seen = 0
+        self.pending = None  # addressed to the old incarnation; regenerate
+        self.state = dict(
+            self.state,
+            theirHeads=None, theirHave=None, theirNeed=None,
+            lastSentHeads=[], sentHashes={},
+        )
+        self._wd_rounds = 0
+        self._wd_stage = 0
+
+    # -------------------------------------------------------------- #
+    # convergence watchdog
+
+    def _watchdog_round(self):
+        """Called after every applied payload (so "messages still flow" by
+        construction): escalates when heads and sharedHeads are both stuck
+        for K rounds short of convergence."""
+        heads = self.driver.heads()
+        shared = self.state["sharedHeads"]
+        their = self.state["theirHeads"]
+        converged = their is not None and heads == their
+        progressed = heads != self._wd_heads or shared != self._wd_shared
+        self._wd_heads = heads
+        self._wd_shared = shared
+        if converged or progressed:
+            self._wd_rounds = 0
+            self._wd_stage = 0
+            return
+        self._wd_rounds += 1
+        if self._wd_rounds < self.config.watchdog_rounds:
+            return
+        self._wd_rounds = 0
+        _M_WD_STALLS.inc()
+        self.stats["stalls"] += 1
+        _M_WD_ESCALATIONS.inc()
+        self.stats["escalations"] += 1
+        if self._wd_stage == 0:
+            # stage 1 — rebuild the Bloom exchange: clearing sentHashes and
+            # lastSentHeads makes the next generate resend its filter and
+            # re-offer anything wrongly withheld (e.g. a change a stale
+            # sentHashes entry or a Bloom false-positive loop suppressed)
+            self._wd_stage = 1
+            self.state = dict(self.state, lastSentHeads=[], sentHashes={})
+        else:
+            # stage 2 — full reset exchange: treat the peer's filter as
+            # empty (every change Bloom-negative, so all of ours are
+            # offered explicitly) and rebuild ours from scratch
+            self._wd_stage = 0
+            _M_WD_RESETS.inc()
+            self.stats["resets"] += 1
+            self.state = dict(
+                self.state,
+                sharedHeads=[], lastSentHeads=[], sentHashes={},
+                theirHave=[{"lastSync": [], "bloom": b""}],
+                theirNeed=self.state["theirNeed"] or [],
+            )
+
+    # -------------------------------------------------------------- #
+    # channel quarantine (mirrors the doc farm's lifecycle, PR 3)
+
+    @property
+    def quarantined(self) -> bool:
+        return self.quarantine_cause is not None
+
+    def _enter_quarantine(self, cause: SyncProtocolError):
+        global _active_quarantined
+        self.quarantine_cause = cause
+        self.pending = None
+        _M_CHQ_ENTERED.inc()
+        _active_quarantined += 1
+        _M_CHQ_ACTIVE.set(_active_quarantined)
+
+    def release(self):
+        """Returns a quarantined channel to service with a fresh retry
+        budget; the next ``poll()`` regenerates from current state. On a
+        live channel it just resets the in-flight retry budget — call it
+        after a known network heal so a frame that burned most of its
+        budget against the partition is not quarantined by its next
+        timeout."""
+        global _active_quarantined
+        if self.quarantine_cause is None:
+            if self.pending is not None:
+                self.pending["attempt"] = 0
+            return
+        self.quarantine_cause = None
+        _M_CHQ_RELEASED.inc()
+        _active_quarantined = max(0, _active_quarantined - 1)
+        _M_CHQ_ACTIVE.set(_active_quarantined)
+
+    def check(self):
+        """Raises ``ChannelQuarantinedError`` if the channel is shed (the
+        explicit-error analogue of the silent shed in ``handle``)."""
+        if self.quarantine_cause is not None:
+            raise ChannelQuarantinedError(
+                f"sync channel is quarantined ({self.quarantine_cause}); "
+                "release() to retry"
+            )
+
+    # -------------------------------------------------------------- #
+    # persistence (resumable sessions)
+
+    def save(self) -> bytes:
+        """Durable snapshot: the inner state's sharedHeads plus the session
+        extension (epoch and seq/ack watermarks). In-flight frames are
+        deliberately not persisted — after restore the peer's
+        retransmissions and our regenerated frames re-fill the channel."""
+        return encode_sync_state(self.state, session={
+            "epoch": self.epoch,
+            "seqOut": self.seq_out,
+            "lastSeen": self.last_seen,
+            "peerEpoch": self.peer_epoch,
+        })
+
+    @classmethod
+    def restore(cls, blob, driver, *, clock=None, rng=None, config=None):
+        """Resumes a channel from ``save()`` output. Pre-extension blobs
+        (plain ``encode_sync_state``) restore too — the session then starts
+        with a fresh epoch, which the peer handles as a restart."""
+        state = decode_sync_state(blob)
+        session = state.pop("session", None)
+        restored = cls(driver, clock=clock, rng=rng, config=config, state=state)
+        if session is not None:
+            restored.epoch = session["epoch"]
+            restored.seq_out = session["seqOut"]
+            restored.last_seen = session["lastSeen"]
+            restored.peer_epoch = session["peerEpoch"]
+        return restored

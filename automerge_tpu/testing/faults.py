@@ -39,6 +39,9 @@ POINTS = (
     "engine.apply_batch",    # host driver, before the merge program
     "engine.visible_state",  # host driver, before the visibility program
     "sync.receive_message",  # before a peer message is decoded
+    "session.receive",       # before a session frame is decoded (frame=bytes)
+    "chaos.send",            # chaos transport, before a frame enters a link
+    "chaos.deliver",         # chaos transport, before a frame leaves a link
 )
 
 

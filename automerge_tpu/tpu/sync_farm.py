@@ -64,6 +64,11 @@ _M_BLOOM_PROBES = _METRICS.counter("sync.bloom.probes")
 _M_BLOOM_HITS = _METRICS.counter("sync.bloom.hits")
 _M_BLOOM_FP = _METRICS.counter("sync.bloom.false_positives")
 _M_REJECTED = _METRICS.counter("sync.messages.rejected")
+_M_SHED_QUARANTINED = _METRICS.counter(
+    "sync.messages.shed_quarantined",
+    "sync channels skipped in generate_messages because the doc farm has "
+    "their document quarantined (release_quarantine restores them)",
+)
 
 
 def filters_from_bytes(blobs):
@@ -113,6 +118,25 @@ class SyncFarm:
     def init_state():
         return init_sync_state()
 
+    def make_session(self, d, *, clock=None, rng=None, config=None,
+                     state=None):
+        """A supervised ``SyncSession`` (sync_session.py) for document
+        ``d``'s channel to one peer: seq/ack framing, retransmission with
+        backoff, peer-restart detection and the convergence watchdog, over
+        this farm's batched generate/receive."""
+        from ..sync_session import FarmDriver, SyncSession
+
+        return SyncSession(FarmDriver(self, d), clock=clock, rng=rng,
+                           config=config, state=state)
+
+    def restore_session(self, d, blob, *, clock=None, rng=None, config=None):
+        """Resumes a persisted supervised channel (``SyncSession.save()``)
+        for document ``d``."""
+        from ..sync_session import FarmDriver, SyncSession
+
+        return SyncSession.restore(blob, FarmDriver(self, d), clock=clock,
+                                   rng=rng, config=config)
+
     # -------------------------------------------------------------- #
     # generate (sync.js:327, batched)
 
@@ -126,7 +150,17 @@ class SyncFarm:
         batch each."""
         n = len(channels)
         plans = []
+        # a doc quarantined by the farm's per-doc isolation (PR 3) must not
+        # be offered over sync: its host state is the pre-fault snapshot,
+        # so advertising heads/filters from it would invite deliveries the
+        # farm will shed anyway. The channel resumes after
+        # release_quarantine.
+        quarantined = self.farm.quarantine
         for d, state in channels:
+            if d in quarantined:
+                plans.append({"shed": True})
+                _M_SHED_QUARANTINED.inc()
+                continue
             plans.append(self._plan_generate(d, state))
 
         # batched `have` filter construction
@@ -244,6 +278,8 @@ class SyncFarm:
     def _finish_generate(self, d, state, plan):
         """Host phase 2: reference control flow of generateSyncMessage."""
         farm = self.farm
+        if plan.get("shed"):
+            return state, None
         if plan.get("reset"):
             msg = {
                 "heads": plan["our_heads"], "need": [],

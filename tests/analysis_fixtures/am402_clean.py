@@ -1,0 +1,16 @@
+"""AM402 clean fixture: the injectable clock/RNG pattern the rule demands."""
+# amlint: sync-data-plane
+import random
+
+
+def make_rng(seed):
+    # constructing an RNG instance IS the injection point — allowed
+    return random.Random(seed)
+
+
+def deadline_passed(clock, sent_at, timeout):
+    return clock() - sent_at > timeout
+
+
+def backoff(rng, attempt, cap):
+    return rng.uniform(0.0, min(cap, 0.5 * 2 ** attempt))

@@ -1,0 +1,8 @@
+"""AM402 suppressed fixture: the single justified real-time default."""
+# amlint: sync-data-plane
+import time
+
+
+def default_clock():
+    # every other call site takes this (or a test clock) as a parameter
+    return time.monotonic()  # amlint: disable=AM402 — the injectable-clock default

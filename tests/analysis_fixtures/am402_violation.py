@@ -1,0 +1,19 @@
+"""AM402 violating fixture: wall-clock and global-RNG calls in supervised
+sync control flow."""
+# amlint: sync-data-plane
+import random
+import time
+from time import monotonic
+
+
+def deadline_passed(sent_at, timeout):
+    return time.time() - sent_at > timeout
+
+
+def backoff(attempt, cap):
+    time.sleep(min(cap, 0.5 * 2 ** attempt))
+    return random.uniform(0.0, cap)
+
+
+def jitter_now():
+    return monotonic() + random.random()

@@ -20,3 +20,11 @@ if "jax" in sys.modules:
     sys.modules["jax"].config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak configurations (chaos convergence sweeps); "
+        "excluded from the tier-1 run (-m 'not slow')",
+    )

@@ -218,3 +218,98 @@ class TestSyncFarm:
                 a_states[d] = state
         for d in range(num_docs):
             assert a.farm.get_heads(d) == b.farm.get_heads(d)
+
+class TestQuarantineShedding:
+    """ISSUE 5 satellite: a doc quarantined by the farm's per-doc isolation
+    (PR 3) must not be offered in generate_messages until released, counted
+    on sync.messages.shed_quarantined."""
+
+    def _quarantine_doc(self, replica, d):
+        from automerge_tpu.testing import faults
+
+        bad = faults.garbage(48)
+        for _ in range(replica.farm.quarantine_threshold):
+            per_doc = [[] for _ in range(replica.farm.num_docs)]
+            per_doc[d] = [bad]
+            replica.farm.apply_changes(per_doc)
+        assert d in replica.farm.quarantine
+
+    def test_quarantined_doc_is_shed_from_generate(self):
+        from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+
+        rng = random.Random(11)
+        a = Replica(2, "aaaaaaaa")
+        for d in range(2):
+            a.edit(d, rng)
+        self._quarantine_doc(a, 0)
+        metrics = get_metrics()
+        metrics.reset()
+        states = [SyncFarm.init_state() for _ in range(2)]
+        with enabled_metrics():
+            out = a.sync.generate_messages(
+                [(d, states[d]) for d in range(2)]
+            )
+        (state0, msg0), (state1, msg1) = out
+        assert msg0 is None          # quarantined channel sheds
+        assert state0 == states[0]   # and leaves its sync state untouched
+        assert msg1 is not None      # healthy neighbour unaffected
+        snap = metrics.as_dict()
+        assert snap["sync.messages.shed_quarantined"]["value"] == 1
+
+    def test_release_resumes_sync_on_same_channel(self):
+        rng = random.Random(12)
+        a = Replica(1, "aaaaaaaa")
+        b = Replica(1, "bbbbbbbb")
+        a.edit(0, rng)
+        self._quarantine_doc(a, 0)
+        state = SyncFarm.init_state()
+        ((_, msg),) = a.sync.generate_messages([(0, state)])
+        assert msg is None
+        a.farm.release_quarantine(0)
+        # the same replicas converge normally after release (check_bytes
+        # is off: the quarantine deliveries only touched the farm, so the
+        # differential backends are not in lockstep for doc 0's farm)
+        sync_farms(a, b, 1, check_bytes=False)
+        assert a.farm.get_heads(0) == b.farm.get_heads(0)
+
+
+class TestFarmReceiveIdempotency:
+    """ISSUE 5 satellite: double-delivery of the same sync message through
+    the batched receive path is a no-op on heads and farm state."""
+
+    def test_double_receive_is_noop(self):
+        import json
+
+        rng = random.Random(13)
+        a = Replica(1, "aaaaaaaa")
+        b = Replica(1, "bbbbbbbb")
+        for _ in range(3):
+            a.edit(0, rng)
+        a_state = SyncFarm.init_state()
+        b_state = SyncFarm.init_state()
+        # drive one exchange until a message carries changes
+        msg_with_changes = None
+        for _ in range(6):
+            ((a_state, msg),) = a.sync.generate_messages([(0, a_state)])
+            if msg is not None and seq_sync.decode_sync_message(msg)["changes"]:
+                msg_with_changes = msg
+                break
+            if msg is not None:
+                ((b_state, _),) = b.sync.receive_messages([(0, b_state, msg)])
+            ((b_state, back),) = b.sync.generate_messages([(0, b_state)])
+            if back is not None:
+                ((a_state, _),) = a.sync.receive_messages([(0, a_state, back)])
+        assert msg_with_changes is not None
+        ((b_state1, patch1),) = b.sync.receive_messages(
+            [(0, b_state, msg_with_changes)]
+        )
+        assert patch1 is not None
+        heads = b.farm.get_heads(0)
+        doc_json = json.dumps(b.farm.get_patch(0), sort_keys=True)
+        # identical bytes again: heads, doc state and sharedHeads stable
+        ((b_state2, _patch2),) = b.sync.receive_messages(
+            [(0, b_state1, msg_with_changes)]
+        )
+        assert b.farm.get_heads(0) == heads
+        assert json.dumps(b.farm.get_patch(0), sort_keys=True) == doc_json
+        assert b_state2["sharedHeads"] == b_state1["sharedHeads"]

@@ -1,0 +1,389 @@
+"""Unit suite for the sync supervision layer (automerge_tpu/sync_session.py):
+frame codec, stop-and-wait seq/ack, retransmission with backoff, channel
+quarantine, peer-restart re-handshake, the convergence watchdog, and
+resumable session state. Everything runs on a ManualClock with seeded RNGs
+— no wall time, no sleeps."""
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import sync as Sync
+from automerge_tpu.errors import (
+    ChannelQuarantinedError,
+    RetryExhaustedError,
+    SyncFrameError,
+    SyncProtocolError,
+)
+from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+from automerge_tpu.sync_session import (
+    BackendDriver,
+    SessionConfig,
+    SyncSession,
+    decode_frame,
+    encode_frame,
+)
+from automerge_tpu.testing.chaos import ManualClock
+
+
+def make_backend(actor, keys=()):
+    backend = Backend.init()
+    state = None
+    for i, key in enumerate(keys):
+        buf = am.encode_change({
+            "actor": actor, "seq": i + 1, "startOp": i + 1, "time": 0,
+            "deps": Backend.get_heads(backend),
+            "ops": [{"action": "set", "obj": "_root", "key": key,
+                     "datatype": "uint", "value": i, "pred": []}],
+        })
+        backend, _ = Backend.apply_changes(backend, [buf])
+    return backend
+
+
+def make_pair(a_keys=("x",), b_keys=(), *, config=None, clock=None,
+              seed_a=1, seed_b=2):
+    clock = clock or ManualClock()
+    da = BackendDriver(make_backend("aaaaaaaa", a_keys))
+    db = BackendDriver(make_backend("bbbbbbbb", b_keys))
+    sa = SyncSession(da, clock=clock, rng=random.Random(seed_a), config=config)
+    sb = SyncSession(db, clock=clock, rng=random.Random(seed_b), config=config)
+    return clock, sa, sb
+
+
+def drive(clock, sa, sb, rounds=30, step=0.05):
+    """Lossless shuttle: poll both, deliver both, tick the clock."""
+    for _ in range(rounds):
+        fa, fb = sa.poll(), sb.poll()
+        if fa is not None:
+            sb.handle(fa)
+        if fb is not None:
+            sa.handle(fb)
+        if fa is None and fb is None and sa.driver.heads() == sb.driver.heads():
+            return True
+        clock.advance(step if (fa or fb) else 0.26)
+    return sa.driver.heads() == sb.driver.heads()
+
+
+# ---------------------------------------------------------------------- #
+# frame codec
+
+
+class TestFrameCodec:
+    def test_round_trip_payload(self):
+        frame = encode_frame(7, 3, 2, b"payload-bytes")
+        assert decode_frame(frame) == {
+            "epoch": 7, "seq": 3, "ack": 2, "payload": b"payload-bytes",
+        }
+
+    def test_round_trip_ack_only(self):
+        frame = encode_frame(9, 0, 5, None)
+        assert decode_frame(frame) == {
+            "epoch": 9, "seq": 0, "ack": 5, "payload": None,
+        }
+
+    @pytest.mark.parametrize("bit", [8, 40, 64, 200])
+    def test_corrupt_frame_rejected_by_checksum(self, bit):
+        frame = bytearray(encode_frame(1, 1, 0, b"payload-bytes"))
+        bit %= len(frame) * 8
+        frame[bit >> 3] ^= 1 << (bit & 7)
+        with pytest.raises(SyncFrameError):
+            decode_frame(bytes(frame))
+
+    def test_truncated_frame_rejected(self):
+        frame = encode_frame(1, 1, 0, b"payload-bytes")
+        for keep in (0, 1, 3, len(frame) // 2, len(frame) - 1):
+            with pytest.raises(SyncFrameError):
+                decode_frame(frame[:keep])
+
+    def test_wrong_type_rejected(self):
+        with pytest.raises(SyncFrameError):
+            decode_frame(b"\x42" + encode_frame(1, 1, 0, b"x")[1:])
+
+    def test_frame_error_is_sync_protocol_error(self):
+        assert issubclass(SyncFrameError, SyncProtocolError)
+        assert issubclass(RetryExhaustedError, SyncProtocolError)
+        assert issubclass(ChannelQuarantinedError, SyncProtocolError)
+
+
+# ---------------------------------------------------------------------- #
+# stop-and-wait + retransmission
+
+
+class TestSupervision:
+    def test_lossless_convergence_and_inner_bytes_unchanged(self):
+        """On a clean transport the inner payloads are byte-identical to
+        the unsupervised protocol's messages (wire compatibility)."""
+        clock, sa, sb = make_pair(("x", "y"), ())
+        ref_a = BackendDriver(make_backend("aaaaaaaa", ("x", "y")))
+        ref_state = Sync.init_sync_state()
+        frame = sa.poll()
+        ref_state, ref_msg = Sync.generate_sync_message(ref_a.backend, ref_state)
+        assert decode_frame(frame)["payload"] == ref_msg
+        sb.handle(frame)
+        assert drive(clock, sa, sb)
+        assert sa.driver.heads() == sb.driver.heads()
+
+    def test_stop_and_wait_single_outstanding_frame(self):
+        clock, sa, sb = make_pair()
+        first = sa.poll()
+        assert first is not None and sa.pending is not None
+        # before the deadline, no retransmission and no new payload
+        assert sa.poll() is None
+        clock.advance(0.5)
+        assert sa.poll() is None
+
+    def test_timeout_retransmits_same_seq_with_backoff(self):
+        clock, sa, sb = make_pair()
+        first = decode_frame(sa.poll())
+        clock.advance(1.01)  # past the 1.0s default timeout
+        second = decode_frame(sa.poll())
+        assert second["seq"] == first["seq"]
+        assert second["payload"] == first["payload"]
+        assert sa.stats["retransmits"] == 1
+        assert sa.stats["timeouts"] == 1
+        # the next deadline includes timeout + jittered backoff
+        assert sa.pending["deadline"] >= clock.now() + 1.0
+
+    def test_ack_clears_pending(self):
+        clock, sa, sb = make_pair()
+        frame = sa.poll()
+        sb.handle(frame)
+        reply = sb.poll()  # carries ack for sa's frame
+        sa.handle(reply)
+        assert sa.pending is None
+
+    def test_duplicate_frame_is_idempotent_noop(self):
+        clock, sa, sb = make_pair(("x",), ())
+        frame = sa.poll()
+        sb.handle(frame)
+        heads_before = sb.driver.heads()
+        saved = Backend.save(sb.driver.backend)
+        state_before = dict(sb.state)
+        assert sb.handle(frame) is None  # exact duplicate
+        assert sb.stats["dup_dropped"] == 1
+        assert sb.driver.heads() == heads_before
+        assert Backend.save(sb.driver.backend) == saved
+        assert sb.state == state_before
+        assert sb.ack_owed  # the peer is re-acked so it stops retransmitting
+
+    def test_rejected_payload_is_not_acked(self):
+        """An envelope that decodes but carries a corrupt inner payload
+        must not advance the seq watermark: the peer's intact
+        retransmission has to get a clean retry."""
+        clock, sa, sb = make_pair(("x",), ())
+        frame = sa.poll()
+        inner = decode_frame(frame)
+        # a sync-typed payload whose heads count never terminates: the
+        # inner decode raises, so the envelope must not be acked
+        bad_payload = b"\x42" + b"\xff" * 6
+        bad_frame = encode_frame(inner["epoch"], inner["seq"], 0, bad_payload)
+        with pytest.raises(SyncProtocolError):
+            sb.handle(bad_frame)
+        assert sb.last_seen == 0
+        assert not sb.ack_owed
+        # the intact frame still applies afterwards
+        assert sb.handle(frame) is not None or sb.last_seen == inner["seq"]
+
+    def test_retry_budget_exhaustion_quarantines_channel(self):
+        config = SessionConfig(timeout=1.0, max_retries=2, backoff_cap=0.1)
+        clock, sa, sb = make_pair(config=config)
+        assert sa.poll() is not None
+        for _ in range(3):
+            clock.advance(20.0)
+            sa.poll()
+        assert sa.quarantined
+        assert isinstance(sa.quarantine_cause, RetryExhaustedError)
+        assert sa.poll() is None  # quarantined channels emit nothing
+        # incoming traffic is shed, not raised
+        frame = sb.poll()
+        assert sa.handle(frame) is None
+        assert sa.stats["shed"] == 1
+        with pytest.raises(ChannelQuarantinedError):
+            sa.check()
+        # release restores service with a fresh budget
+        sa.release()
+        assert not sa.quarantined
+        assert sa.poll() is not None
+
+    def test_backoff_is_deterministic_under_seeded_rng(self):
+        def run(seed):
+            config = SessionConfig(timeout=1.0, max_retries=6)
+            clock, sa, _sb = make_pair(config=config, seed_a=seed, seed_b=99)
+            sa.poll()
+            deadlines = []
+            for _ in range(4):
+                clock.advance(1000.0)
+                sa.poll()
+                deadlines.append(sa.pending["deadline"] - clock.now())
+            return deadlines
+
+        assert run(5) == run(5)
+        assert run(5) != run(6)
+
+    def test_backoff_grows_toward_cap(self):
+        config = SessionConfig(timeout=1.0, max_retries=20,
+                               backoff_base=0.5, backoff_cap=8.0)
+        clock, sa, _sb = make_pair(config=config)
+        sa.poll()
+        for attempt in range(1, 10):
+            clock.advance(1e6)
+            sa.poll()
+            delay = sa.pending["deadline"] - clock.now() - config.timeout
+            ceiling = min(config.backoff_cap,
+                          config.backoff_base * 2 ** (attempt - 1))
+            assert 0.0 <= delay <= ceiling
+
+
+# ---------------------------------------------------------------------- #
+# peer restart + resumable sessions
+
+
+class TestRestartAndResume:
+    def test_peer_restart_triggers_clean_rehandshake(self):
+        clock, sa, sb = make_pair(("x", "y"), ())
+        assert drive(clock, sa, sb)
+        # b restarts with nothing: fresh doc, fresh session, new epoch
+        db = BackendDriver(Backend.init())
+        sb2 = SyncSession(db, clock=clock, rng=random.Random(77))
+        assert drive(clock, sa, sb2)
+        assert sa.stats["peer_restarts"] == 1
+        assert sa.driver.heads() == sb2.driver.heads()
+
+    def test_save_restore_round_trips_session_fields(self):
+        clock, sa, sb = make_pair(("x",), ())
+        assert drive(clock, sa, sb)
+        blob = sa.save()
+        restored = SyncSession.restore(blob, sa.driver, clock=clock,
+                                       rng=random.Random(9))
+        assert restored.epoch == sa.epoch
+        assert restored.seq_out == sa.seq_out
+        assert restored.last_seen == sa.last_seen
+        assert restored.peer_epoch == sa.peer_epoch
+        assert restored.state["sharedHeads"] == sa.state["sharedHeads"]
+
+    def test_restored_session_resumes_without_restart_detection(self):
+        """A process restart with persisted state is seamless: the peer
+        sees the same epoch and the same seq continuity."""
+        clock, sa, sb = make_pair(("x",), ())
+        assert drive(clock, sa, sb)
+        blob = sa.save()
+        sa2 = SyncSession.restore(blob, sa.driver, clock=clock,
+                                  rng=random.Random(9))
+        # new local edit after the resume
+        buf = am.encode_change({
+            "actor": "aaaaaaaa", "seq": 2, "startOp": 2, "time": 0,
+            "deps": sa.driver.heads(),
+            "ops": [{"action": "set", "obj": "_root", "key": "z",
+                     "datatype": "uint", "value": 9, "pred": []}],
+        })
+        sa2.driver.backend, _ = Backend.apply_changes(sa2.driver.backend, [buf])
+        assert drive(clock, sa2, sb)
+        assert sb.stats["peer_restarts"] == 0
+        assert sa2.driver.heads() == sb.driver.heads()
+
+    def test_legacy_blob_restores_with_fresh_epoch(self):
+        state = Sync.init_sync_state()
+        legacy = Sync.encode_sync_state(state)  # no session extension
+        restored = SyncSession.restore(
+            legacy, BackendDriver(Backend.init()),
+            clock=ManualClock(), rng=random.Random(3),
+        )
+        assert restored.seq_out == 0
+        assert restored.last_seen == 0
+        assert restored.peer_epoch is None
+        assert restored.epoch != 0
+
+
+# ---------------------------------------------------------------------- #
+# convergence watchdog
+
+
+class TestWatchdog:
+    def _stalled_pair(self, config=None):
+        """A pair wedged the pathological way: every one of a's changes is
+        wrongly marked as already sent (the observable end-state of a
+        Bloom false-positive loop under loss), so the inner protocol
+        exchanges heads forever without ever attaching the changes. The
+        peer is non-empty: an empty peer's heads=[] message triggers the
+        reference's own sentHashes reset (sync.js:435), masking the
+        stall."""
+        config = config or SessionConfig(watchdog_rounds=3)
+        clock, sa, sb = make_pair(("x", "y", "z"), ("b0",), config=config)
+        hashes = [
+            am.decode_change(c)["hash"]
+            for c in Backend.get_all_changes(sa.driver.backend)
+        ]
+        sa.state = dict(sa.state, sentHashes={h: True for h in hashes})
+        return clock, sa, sb
+
+    def test_stalled_pair_escalates_and_recovers(self):
+        clock, sa, sb = self._stalled_pair()
+        assert drive(clock, sa, sb, rounds=120)
+        assert sa.stats["stalls"] + sb.stats["stalls"] >= 1
+        assert sa.stats["escalations"] + sb.stats["escalations"] >= 1
+        assert sa.driver.heads() == sb.driver.heads()
+
+    def test_progress_resets_watchdog(self):
+        clock, sa, sb = make_pair(("x", "y"), ())
+        assert drive(clock, sa, sb)
+        assert sa.stats["stalls"] == 0
+        assert sb.stats["stalls"] == 0
+        assert sa._wd_rounds == 0
+
+    def test_full_reset_stage_fires_after_rebuild_fails(self):
+        """Stage 1 clears sentHashes, which heals the injected stall — so
+        to reach stage 2 the poison is re-applied whenever stage 1 cleared
+        it, forcing the watchdog through rebuild into the reset exchange."""
+        config = SessionConfig(watchdog_rounds=2)
+        clock, sa, sb = self._stalled_pair(config=config)
+        poison = dict(sa.state["sentHashes"])
+        for _ in range(400):
+            if sa.stats["resets"] or sb.stats["resets"]:
+                break
+            # keep the stall alive through stage 1: whenever the rebuild
+            # cleared sentHashes, re-poison before the next generate
+            if sa._wd_stage == 1 and not sa.state["sentHashes"]:
+                sa.state = dict(sa.state, sentHashes=dict(poison))
+            fa, fb = sa.poll(), sb.poll()
+            if fa is not None:
+                sb.handle(fa)
+            if fb is not None:
+                sa.handle(fb)
+            clock.advance(0.05 if (fa or fb) else 0.26)
+        assert sa.stats["resets"] >= 1
+        # after the reset exchange the pair converges even with the poison
+        # left in place once (reset clears it server-side)
+        assert drive(clock, sa, sb, rounds=60)
+
+
+# ---------------------------------------------------------------------- #
+# metrics
+
+
+class TestSessionMetrics:
+    def test_session_and_watchdog_metrics_recorded(self):
+        metrics = get_metrics()
+        metrics.reset()
+        with enabled_metrics():
+            config = SessionConfig(timeout=1.0, max_retries=2,
+                                   watchdog_rounds=3, backoff_cap=0.2)
+            clock, sa, sb = make_pair(("x",), (), config=config)
+            frame = sa.poll()
+            sb.handle(frame)
+            sb.handle(frame)  # duplicate
+            clock.advance(5.0)
+            sa.poll()  # retransmit 1
+            for _ in range(3):
+                clock.advance(50.0)
+                sa.poll()
+            assert sa.quarantined
+            sa.release()
+        snap = metrics.as_dict()
+        assert snap["sync.session.dup_dropped"]["value"] == 1
+        assert snap["sync.session.retransmits"]["value"] >= 1
+        assert snap["sync.session.timeouts"]["value"] >= 2
+        assert snap["sync.session.backoff_ms"]["count"] >= 1
+        assert snap["sync.channel.quarantine.entered"]["value"] == 1
+        assert snap["sync.channel.quarantine.released"]["value"] == 1
+        assert snap["sync.channel.quarantine.active"]["value"] == 0
