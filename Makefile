@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke chaos
+.PHONY: lint test native obs-report faults bench-smoke chaos serve
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -30,6 +30,14 @@ chaos:
 # "Performance"); also runs as a tier-1 test (tests/test_bench_smoke.py)
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --quick
+
+# serving front-door demo (README "Serving"): 192 simulated clients over
+# the chaos transport in simulated time through the session multiplexer +
+# dynamic batcher; gates on convergence, batch occupancy and zero
+# unexplained sheds. The full-scale harness (10^4+ clients):
+# `python bench.py --serve`; also a tier-1 test (tests/test_serve_smoke.py)
+serve:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve --quick
 
 native:
 	$(MAKE) -C native

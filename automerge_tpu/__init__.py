@@ -14,7 +14,9 @@ from . import uuid as _uuid_module
 from . import frontend as Frontend
 from .columnar import decode_change, encode_change
 from .errors import (
+    AdmissionRejectedError,
     AutomergeError,
+    BackpressureError,
     CausalityError,
     ChannelQuarantinedError,
     ChecksumError,
@@ -67,6 +69,7 @@ __all__ = [
     "CausalityError", "PackingLimitError", "SyncProtocolError",
     "SyncFrameError", "RetryExhaustedError", "ChannelQuarantinedError",
     "QuarantinedError", "DeviceFaultError",
+    "AdmissionRejectedError", "BackpressureError",
 ]
 
 _backend = _default_backend  # swappable via set_default_backend()

@@ -30,10 +30,12 @@ Rule families (see core.RULES for the catalog):
 - **AM3xx boundary**: host-only modules importing the device layer
   (AM301), hidden host syncs inside device profiling phases (AM302),
   metric/span recording inside jit/vmap/Pallas-reachable code (AM303).
-- **AM4xx taxonomy**: data-plane modules raising bare ValueError/TypeError
-  instead of classifiable taxonomy errors (AM401); sync data-plane
-  modules calling wall clocks or the global RNG directly instead of the
-  injectable clock/RNG the chaos suite replays (AM402).
+- **AM4xx taxonomy/serve**: data-plane modules raising bare ValueError/
+  TypeError instead of classifiable taxonomy errors (AM401); sync
+  data-plane modules calling wall clocks or the global RNG directly
+  instead of the injectable clock/RNG the chaos suite replays (AM402);
+  blocking calls (time.sleep, bare socket, synchronous device readbacks)
+  inside serve/ event-loop code (AM403).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.

@@ -62,6 +62,10 @@ RULES: dict[str, tuple[str, str]] = {
     "AM402": ("taxonomy", "direct wall-clock/sleep/global-RNG call "
                           "(time.time/time.sleep/random.*) in a sync "
                           "data-plane module (inject a clock/RNG instead)"),
+    "AM403": ("serve", "blocking call (time.sleep, bare socket, synchronous "
+                       "jax.device_get/block_until_ready) in serve/ "
+                       "event-loop code (the loop must stay non-blocking; "
+                       "justify dispatch-point suppressions)"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -1,4 +1,5 @@
-"""AM401/AM402 — data-plane hygiene: classifiable errors, injectable time.
+"""AM401/AM402/AM403 — data-plane hygiene: classifiable errors, injectable
+time, non-blocking serve loops.
 
 The fault-isolation layer (tpu/farm.py) routes per-document failures by
 taxonomy class (automerge_tpu/errors.py): ``DecodeError`` means re-request
@@ -30,6 +31,16 @@ clocks. Those modules (``SYNC_DATA_PLANE_STEMS``, plus files marked
 ``random.Random`` instance; constructing an RNG (``random.Random(seed)``,
 ``random.SystemRandom()``) is allowed — that *is* the injection point —
 and the one real-time default carries a justified suppression.
+
+AM403 guards the serving front door (automerge_tpu/serve): its core runs
+inside an event loop (asyncio or a simulated-time harness), where ONE
+blocking call stalls every client channel at once. ``time.sleep`` (yield
+with ``await asyncio.sleep`` or let the harness advance the clock), bare
+``socket`` construction (asyncio owns the transports), and synchronous
+device readbacks (``jax.device_get``/``block_until_ready`` — the batcher's
+single flush dispatch is the only place device latency may be paid, with a
+justified suppression) are all banned in serve modules (any file under a
+``serve/`` directory, plus files marked ``# amlint: serve-event-loop``).
 """
 from __future__ import annotations
 
@@ -39,11 +50,13 @@ from pathlib import Path
 
 from .core import FileContext, Finding, dotted_name
 
-#: data-plane module stems the rule applies to
+#: data-plane module stems the rule applies to (serve/ modules face the
+#: same untrusted traffic the farm does: admission decisions and shed
+#: accounting key off error_kind too)
 DATA_PLANE_STEMS = frozenset({
     "codecs", "columnar", "opset", "sync", "farm", "rga",
     "sync_farm", "sync_batch", "sync_session", "transcode", "engine",
-    "text_engine",
+    "text_engine", "server", "batcher", "loadgen",
 })
 
 _MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")
@@ -52,12 +65,26 @@ _MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")
 _BARE = {"ValueError", "TypeError"}
 
 #: sync data-plane module stems AM402 applies to (the modules whose
-#: control flow the chaos suite must be able to replay deterministically)
+#: control flow the chaos suite must be able to replay deterministically;
+#: the serve layer runs whole fleets in simulated time, so it is held to
+#: the same injectable-clock discipline)
 SYNC_DATA_PLANE_STEMS = frozenset({
     "sync", "sync_session", "sync_farm", "sync_batch",
+    "server", "batcher", "loadgen",
 })
 
 _SYNC_MARKER_RE = re.compile(r"#\s*amlint:\s*sync-data-plane")
+
+_SERVE_MARKER_RE = re.compile(r"#\s*amlint:\s*serve-event-loop")
+
+#: calls that block the serving event loop (AM403): sleeps, bare socket
+#: construction/dialing, and synchronous device readbacks. Matched on the
+#: dotted prefix (``socket.``) or the exact name; ``block_until_ready`` /
+#: ``device_get`` are also caught as method/attr tails because the array
+#: handle they block on can be any local name.
+_BLOCKING_CALLS = frozenset({"time.sleep", "jax.device_get"})
+_BLOCKING_PREFIXES = ("socket.",)
+_BLOCKING_ATTRS = frozenset({"block_until_ready", "device_get"})
 
 #: wall-clock reads and sleeps that make supervised control flow
 #: unreplayable (call sites must take an injected clock instead)
@@ -82,6 +109,13 @@ def _in_sync_scope(ctx: FileContext) -> bool:
     return (
         Path(ctx.path).stem in SYNC_DATA_PLANE_STEMS
         or _SYNC_MARKER_RE.search(ctx.source) is not None
+    )
+
+
+def _in_serve_scope(ctx: FileContext) -> bool:
+    return (
+        "serve" in Path(ctx.path).parts
+        or _SERVE_MARKER_RE.search(ctx.source) is not None
     )
 
 
@@ -131,11 +165,52 @@ def _check_am402(ctx: FileContext, findings: list[Finding]) -> None:
             ))
 
 
+def _sleep_aliases(tree: ast.Module) -> set[str]:
+    """Local names bound to ``time.sleep`` via ``from time import ...``."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or node.module != "time":
+            continue
+        for alias in node.names:
+            if alias.name == "sleep":
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _check_am403(ctx: FileContext, findings: list[Finding]) -> None:
+    sleep_names = _sleep_aliases(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        tail = name.rsplit(".", 1)[-1]
+        blocking = (
+            name in _BLOCKING_CALLS
+            or name.startswith(_BLOCKING_PREFIXES)
+            or tail in _BLOCKING_ATTRS
+            or name in sleep_names
+        )
+        if blocking:
+            findings.append(ctx.finding(
+                "AM403", node,
+                f"blocking {name}() call in serve event-loop code: one "
+                "blocked call stalls every client channel at once — yield "
+                "with `await asyncio.sleep`, let the injected clock/harness "
+                "advance time, hand transports to asyncio, and pay device "
+                "readback latency only at the batcher's flush dispatch "
+                "(suppress there with a justification)",
+            ))
+
+
 def check(ctxs: list[FileContext]) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         if _in_sync_scope(ctx):
             _check_am402(ctx, findings)
+        if _in_serve_scope(ctx):
+            _check_am403(ctx, findings)
         if not _in_scope(ctx):
             continue
         for node in ast.walk(ctx.tree):

@@ -26,7 +26,9 @@ Hierarchy::
     │   ├── SyncFrameError             malformed session envelope (outer framing)
     │   ├── RetryExhaustedError        retransmission budget spent; channel quarantined
     │   └── ChannelQuarantinedError    traffic shed: the sync channel is quarantined
-    └── QuarantinedError               delivery shed: the doc is quarantined
+    ├── QuarantinedError               delivery shed: the doc is quarantined
+    ├── AdmissionRejectedError         serve front door refused the request at admission
+    └── BackpressureError              serve front door: tenant queue full, retry later
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
@@ -114,6 +116,25 @@ class QuarantinedError(AutomergeError):
     farm's quarantine set (see ``TpuDocFarm.release_quarantine``)."""
 
     kind = "quarantined"
+
+
+class AdmissionRejectedError(AutomergeError):
+    """The serving front door (automerge_tpu.serve) refused a request at
+    admission — e.g. the target document is in the farm's quarantine set,
+    so queueing its traffic would only grow a batch the farm will shed.
+    The client's retransmission path is the retry loop: once the cause
+    clears (``release_quarantine``), the same frame is admitted."""
+
+    kind = "admission"
+
+
+class BackpressureError(AutomergeError):
+    """The serving front door's bounded per-tenant queue is full: the
+    tenant is submitting faster than the batcher drains. The request was
+    not enqueued; the client should back off and retransmit (the session
+    layer's timeout/backoff machinery does exactly that)."""
+
+    kind = "backpressure"
 
 
 def error_kind(exc: BaseException) -> str:

@@ -109,6 +109,7 @@ class Histogram:
             "sum": self.sum,
             "p50": self.percentile(0.50),
             "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
         }
 
 
@@ -189,7 +190,8 @@ class MetricsRegistry:
                     continue
                 detail = (
                     f"count={snap['count']} sum={snap['sum']:.4g} "
-                    f"p50={_fmt(snap['p50'])} p95={_fmt(snap['p95'])}"
+                    f"p50={_fmt(snap['p50'])} p95={_fmt(snap['p95'])} "
+                    f"p99={_fmt(snap['p99'])}"
                 )
             else:
                 if skip_zero and not snap["value"]:

@@ -1,0 +1,323 @@
+"""Session multiplexer: one supervised sync channel per client, one farm.
+
+``AmServer`` is the front door a fleet of editors connects to. Each client
+channel owns a PR 5 ``SyncSession`` created through the batched farm's
+``SyncFarm.make_session`` (or ``restore_session`` for resume-after-
+restart), so every reliability property of the supervised protocol —
+seq/ack framing, retransmission with backoff, duplicate idempotency,
+epoch-based peer-restart detection, the convergence watchdog — holds per
+channel with zero new wire format. Incoming payload frames do NOT apply
+individually: they run through the ``DynamicBatcher``, which turns a
+window of frames from many clients into one batched farm dispatch and
+fans the patches and owed replies back out.
+
+The core is sans-io and clock-injected: ``receive`` ingests a frame,
+``tick`` flushes the batcher when its window is due, ``pump`` drains
+every frame the sessions owe (acks, replies, retransmissions). A test, a
+chaos harness or the load generator calls those three methods against a
+``ManualClock`` and the whole service runs in simulated time (amlint
+AM402/AM403 keep wall clocks and blocking calls out of this module). The
+``serve_forever`` adapter binds the same core to asyncio streams with
+length-prefixed frames for real transports.
+
+Connect/resume/restart ride the existing epoch machinery:
+
+- ``connect`` creates a fresh server-side session (new epoch). A client
+  that restarts and reconnects keeps talking to the *same* server
+  session, whose peer-restart detection sees the client's new epoch and
+  re-handshakes cleanly.
+- ``resume`` rebuilds a channel from a ``save_session`` blob after a
+  *server* restart; clients observe the same epoch and continue without
+  a restart exchange.
+"""
+from __future__ import annotations
+
+import random
+
+from ..errors import AutomergeError
+from ..obs.metrics import get_metrics
+from ..sync_session import SessionConfig, _default_clock
+from ..tpu.sync_farm import SyncFarm
+from .batcher import BatcherConfig, DynamicBatcher, FlushReport
+
+_METRICS = get_metrics()
+_M_CONNECTS = _METRICS.counter(
+    "serve.sessions.connected", "client channels opened (connect)"
+)
+_M_RESUMES = _METRICS.counter(
+    "serve.sessions.resumed", "client channels rebuilt from persisted state"
+)
+_M_DISCONNECTS = _METRICS.counter(
+    "serve.sessions.disconnected", "client channels closed"
+)
+_M_ACTIVE = _METRICS.gauge(
+    "serve.sessions.active", "client channels currently connected"
+)
+_M_FRAMES_IN = _METRICS.counter(
+    "serve.frames.received", "frames ingested from client transports"
+)
+_M_FRAMES_OUT = _METRICS.counter(
+    "serve.frames.sent", "frames produced for client transports"
+)
+
+
+class ClientChannel:
+    """One client's server-side state: its supervised session, target doc,
+    tenant (the admission-control dimension) and outbound frame queue."""
+
+    __slots__ = ("client_id", "tenant", "doc", "session", "outbox")
+
+    def __init__(self, client_id, tenant, doc, session):
+        self.client_id = client_id
+        self.tenant = tenant
+        self.doc = doc
+        self.session = session
+        self.outbox: list[bytes] = []
+
+
+class AmServer:
+    """The serving core. Drive it with three calls (all clock-injected):
+
+    - ``receive(client_id, frame)`` — ingest one frame from a client's
+      transport. Admission control runs here; rejections raise
+      (``AdmissionRejectedError``/``BackpressureError``) and the frame is
+      dropped unacked, which is the backpressure signal — the client's
+      session retransmits after its backoff.
+    - ``tick()`` — flush the batcher if its window is due; returns the
+      ``FlushReport`` (or None). Call it from the event loop's timer.
+    - ``pump()`` — collect every (client_id, frame) the sessions owe:
+      acks and replies for channels the last flush touched, plus
+      retransmissions whose deadlines passed. Send them, then call again
+      until it returns nothing.
+    """
+
+    def __init__(self, farm, *, clock=None, rng=None,
+                 config: BatcherConfig | None = None,
+                 session_config: SessionConfig | None = None):
+        self.farm = farm
+        self.sync = SyncFarm(farm)
+        self.clock = clock if clock is not None else _default_clock
+        self.rng = rng if rng is not None else random.Random()
+        self.session_config = session_config or SessionConfig()
+        self.batcher = DynamicBatcher(self.sync, clock=self.clock,
+                                      config=config)
+        self.channels: dict[object, ClientChannel] = {}
+        self._doc_channels: dict[int, set] = {}   # doc -> client ids
+        # channels that may owe frames: polled by pump() until they go
+        # quiet (poll() returns None with nothing in flight)
+        self._active: set = set()
+
+    # -------------------------------------------------------------- #
+    # connect / resume / restart
+
+    def connect(self, client_id, doc: int, tenant: str = "default"
+                ) -> ClientChannel:
+        """Opens (or returns) the channel for ``client_id``. Reconnects
+        keep the existing server-side session: a restarted client arrives
+        with a new epoch and the session's peer-restart detection
+        re-handshakes; a merely-reconnected client continues mid-stream."""
+        channel = self.channels.get(client_id)
+        if channel is not None:
+            self._active.add(client_id)
+            return channel
+        session = self.sync.make_session(
+            doc, clock=self.clock,
+            rng=random.Random(self.rng.getrandbits(64)),
+            config=self.session_config,
+        )
+        return self._install(client_id, tenant, doc, session, _M_CONNECTS)
+
+    def resume(self, client_id, doc: int, blob: bytes,
+               tenant: str = "default") -> ClientChannel:
+        """Rebuilds a channel from a ``save_session`` blob (server
+        restart): same epoch and seq/ack watermarks, so the client
+        continues without a restart exchange."""
+        self.channels.pop(client_id, None)
+        session = self.sync.restore_session(
+            doc, blob, clock=self.clock,
+            rng=random.Random(self.rng.getrandbits(64)),
+            config=self.session_config,
+        )
+        return self._install(client_id, tenant, doc, session, _M_RESUMES)
+
+    def _install(self, client_id, tenant, doc, session, counter
+                 ) -> ClientChannel:
+        channel = ClientChannel(client_id, tenant, doc, session)
+        self.channels[client_id] = channel
+        self._doc_channels.setdefault(doc, set()).add(client_id)
+        self._active.add(client_id)
+        counter.inc()
+        _M_ACTIVE.set(len(self.channels))
+        return channel
+
+    def save_session(self, client_id) -> bytes:
+        """Durable snapshot of one channel (feed to ``resume``)."""
+        return self.channels[client_id].session.save()
+
+    def disconnect(self, client_id) -> None:
+        channel = self.channels.pop(client_id, None)
+        if channel is None:
+            return
+        self._doc_channels.get(channel.doc, set()).discard(client_id)
+        self._active.discard(client_id)
+        _M_DISCONNECTS.inc()
+        _M_ACTIVE.set(len(self.channels))
+
+    # -------------------------------------------------------------- #
+    # the three-call event loop
+
+    def receive(self, client_id, frame: bytes) -> None:
+        """Ingests one frame. Raises ``KeyError`` for unknown clients and
+        the admission errors (``AdmissionRejectedError`` /
+        ``BackpressureError``) when the batcher refuses the frame — the
+        caller drops it and the client's retransmission is the retry."""
+        channel = self.channels[client_id]
+        _M_FRAMES_IN.inc()
+        self.batcher.submit(channel, frame)
+        self._active.add(client_id)
+
+    def wake(self, client_id) -> None:
+        """Marks a channel as possibly owing frames so the next ``pump``
+        polls it (harness hook: e.g. forcing a generate on an unconverged
+        pair after a quiet period)."""
+        if client_id in self.channels:
+            self._active.add(client_id)
+
+    def tick(self) -> FlushReport | None:
+        """Flushes the batcher when its window is due. After a flush,
+        every channel of every touched doc is woken so ``pump`` generates
+        the fan-out (acks to the committers, fresh sync messages carrying
+        the new changes to the doc's other clients)."""
+        if not self.batcher.due():
+            return None
+        report = self.batcher.flush()
+        for doc in report.touched_docs:
+            self._active.update(self._doc_channels.get(doc, ()))
+        for channel, _patch in report.committed:
+            self._active.add(channel.client_id)
+        return report
+
+    def pump(self) -> list[tuple[object, bytes]]:
+        """One sweep over the channels that may owe frames. Returns
+        (client_id, frame) pairs for the transport; channels that produce
+        nothing and have nothing in flight go quiet until a frame, a
+        flush or a reconnect wakes them. Channels with an unacked payload
+        stay awake so their retransmission deadlines are observed.
+
+        Generation is batched: channels whose envelope layer says "the
+        channel is clear, generate" are collected and served by ONE
+        ``SyncFarm.generate_messages`` call — every Bloom filter build and
+        query for the sweep runs as a single device program, the sending-
+        side twin of the batcher's single receive dispatch."""
+        from ..sync_session import NEEDS_GENERATE
+
+        out: list[tuple[object, bytes]] = []
+        need_generate: list[ClientChannel] = []
+        for client_id in sorted(self._active, key=repr):
+            channel = self.channels.get(client_id)
+            if channel is None:
+                self._active.discard(client_id)
+                continue
+            ready = channel.session.poll_begin()
+            if ready is NEEDS_GENERATE:
+                need_generate.append(channel)
+            elif ready is not None:
+                out.append((client_id, ready))
+                _M_FRAMES_OUT.inc()
+            elif channel.session.pending is None:
+                # quiet and nothing awaiting ack: sleep until woken
+                self._active.discard(client_id)
+        if need_generate:
+            results = self.sync.generate_messages(
+                [(c.doc, c.session.state) for c in need_generate]
+            )
+            for channel, (state, payload) in zip(need_generate, results):
+                frame = channel.session.poll_commit(state, payload)
+                if frame is not None:
+                    out.append((channel.client_id, frame))
+                    _M_FRAMES_OUT.inc()
+                elif channel.session.pending is None:
+                    self._active.discard(channel.client_id)
+        return out
+
+    def next_deadline(self) -> float | None:
+        """The earliest future instant the core needs a ``tick``/``pump``
+        call (batcher window expiry or a session retransmission deadline);
+        None when fully idle. Harnesses jump simulated time here."""
+        deadlines = []
+        window = self.batcher.next_deadline()
+        if window is not None:
+            deadlines.append(window)
+        for client_id in self._active:
+            channel = self.channels.get(client_id)
+            if channel is not None and channel.session.pending is not None:
+                deadlines.append(channel.session.pending["deadline"])
+        return min(deadlines, default=None)
+
+    # -------------------------------------------------------------- #
+    # asyncio adapter (real transports; the core above stays sans-io)
+
+    async def serve_forever(self, host: str = "127.0.0.1", port: int = 0):
+        """Binds the core to asyncio streams: 4-byte big-endian length-
+        prefixed frames, one connection per client. The first frame of a
+        connection is a text hello ``b"HELLO <client_id> <doc> <tenant>"``;
+        everything after is session frames. Runs until cancelled. Returns
+        the listening server object (``server.sockets[0].getsockname()``
+        for the bound port)."""
+        import asyncio
+
+        writers: dict[object, asyncio.StreamWriter] = {}
+
+        async def _send_all() -> None:
+            for client_id, frame in self.pump():
+                writer = writers.get(client_id)
+                if writer is None:
+                    continue
+                writer.write(len(frame).to_bytes(4, "big") + frame)
+            for writer in writers.values():
+                await writer.drain()
+
+        async def _flusher() -> None:
+            while True:
+                await asyncio.sleep(self.batcher.config.flush_interval / 2)
+                self.tick()
+                await _send_all()
+
+        async def _handle(reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+            client_id = None
+            try:
+                hello = await _read_frame(reader)
+                parts = hello.decode("utf-8").split()
+                if len(parts) != 4 or parts[0] != "HELLO":
+                    writer.close()
+                    return
+                client_id, doc, tenant = parts[1], int(parts[2]), parts[3]
+                self.connect(client_id, doc, tenant)
+                writers[client_id] = writer
+                while True:
+                    frame = await _read_frame(reader)
+                    try:
+                        self.receive(client_id, frame)
+                    except AutomergeError:
+                        pass  # shed/backpressure: drop; client retransmits
+                    await _send_all()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            finally:
+                if client_id is not None:
+                    writers.pop(client_id, None)
+                writer.close()
+
+        async def _read_frame(reader: asyncio.StreamReader) -> bytes:
+            header = await reader.readexactly(4)
+            return await reader.readexactly(int.from_bytes(header, "big"))
+
+        server = await asyncio.start_server(_handle, host, port)
+        flusher = asyncio.ensure_future(_flusher())
+        try:
+            async with server:
+                await server.serve_forever()
+        finally:
+            flusher.cancel()
+        return server

@@ -77,6 +77,11 @@ FLAG_PAYLOAD = 0x01
 
 _CHECKSUM_SIZE = 4
 
+#: sentinel returned by ``SyncSession.poll_begin`` when the channel is
+#: clear and the caller should run the inner protocol's generate (then
+#: ``poll_commit``) — distinguishable from both None and a frame
+NEEDS_GENERATE = object()
+
 _METRICS = get_metrics()
 _M_RETRANSMITS = _METRICS.counter(
     "sync.session.retransmits", "payload frames retransmitted after a timeout"
@@ -104,6 +109,11 @@ _M_SHED = _METRICS.counter(
     "sync.session.shed",
     "frames shed unprocessed because the channel is quarantined",
 )
+_M_ADVERTS_SUPPRESSED = _METRICS.counter(
+    "sync.session.adverts_suppressed",
+    "regenerated payloads withheld because the peer already acked the "
+    "identical bytes (poll-driven callers would otherwise chatter forever)",
+)
 _M_WD_STALLS = _METRICS.counter(
     "sync.watchdog.stalls",
     "stalled-pair detections (no head progress while messages flowed)",
@@ -127,7 +137,11 @@ _M_CHQ_ACTIVE = _METRICS.gauge(
     "sync.channel.quarantine.active", "channels currently quarantined"
 )
 
-_active_quarantined = 0
+
+def _set_active_quarantined():
+    # derived from the enter/release counters rather than a module global,
+    # so a registry reset() re-zeros the gauge consistently with them
+    _M_CHQ_ACTIVE.set(max(0, _M_CHQ_ENTERED.value - _M_CHQ_RELEASED.value))
 
 
 # ---------------------------------------------------------------------- #
@@ -277,10 +291,24 @@ class SyncSession:
         self.pending = None       # unacked outgoing payload frame, or None
         self.ack_owed = False
         self.quarantine_cause = None
+        # the payload the peer last acknowledged, plus how many inbound
+        # payloads had been applied when it was sent: a regenerated
+        # payload byte-identical to it is suppressed (see poll_commit)
+        # UNLESS the peer has sent us a payload since — without the
+        # suppression, a poll-driven caller (the serving loop) chatters
+        # forever once one side reaches the reference protocol's
+        # reply-suppressed terminal state (receiveSyncMessage sets
+        # lastSentHeads = msg.heads, so the peer's theirHeads stays stale
+        # and generate keeps re-advertising); without the payload-since
+        # escape, the suppression would silence the head-exchange chatter
+        # the convergence watchdog counts stalled rounds on
+        self._acked_payload = None
+        self._acked_rx_mark = -1
+        self._payloads_applied = 0
         self.stats = {
             "retransmits": 0, "dup_dropped": 0, "timeouts": 0,
             "backoff_ms": 0.0, "peer_restarts": 0, "shed": 0,
-            "stalls": 0, "escalations": 0, "resets": 0,
+            "stalls": 0, "escalations": 0, "resets": 0, "suppressed": 0,
         }
         self._wd_heads = None
         self._wd_shared = None
@@ -295,6 +323,21 @@ class SyncSession:
         whenever the transport can send: it retransmits on expired
         deadlines, generates the next protocol message when the channel is
         clear, and emits owed acks."""
+        ready = self.poll_begin()
+        if ready is not NEEDS_GENERATE:
+            return ready
+        state, payload = self.driver.generate(self.state)
+        return self.poll_commit(state, payload)
+
+    def poll_begin(self):
+        """The pre-generate half of ``poll``: quarantine shed, owed acks
+        while a frame is in flight, and the retransmission/timeout path.
+        Returns a frame (or None) when the channel needs no generation,
+        or the ``NEEDS_GENERATE`` sentinel when the caller should run the
+        inner protocol's generate and finish with ``poll_commit``. The
+        serving multiplexer uses this split to batch MANY sessions'
+        generates into one device program (``SyncFarm.generate_messages``)
+        instead of one dispatch per channel."""
         if self.quarantine_cause is not None:
             return None
         now = self.clock()
@@ -322,16 +365,36 @@ class SyncSession:
                 self.epoch, self.pending["seq"], self.last_seen,
                 self.pending["payload"],
             )
-        state, payload = self.driver.generate(self.state)
+        return NEEDS_GENERATE
+
+    def poll_commit(self, state, payload):
+        """The post-generate half of ``poll``: adopts the new sync state
+        and frames the payload (fresh seq, retransmission deadline), or
+        emits the owed ack when there is nothing (left) to say."""
         self.state = state
         if payload is None:
+            return self._ack_frame() if self.ack_owed else None
+        if (
+            payload == self._acked_payload
+            and self._payloads_applied == self._acked_rx_mark
+        ):
+            # byte-identical to a payload the peer already acknowledged,
+            # and the peer has said nothing since: retransmitting carries
+            # zero new information and only restarts the ack->regenerate
+            # chatter loop. Stay quiet; any real event (our heads move, a
+            # peer payload arrives — which re-arms this check so the
+            # watchdog's stalled-round chatter still flows — a restart or
+            # a watchdog reset) changes the bytes or the mark.
+            self.stats["suppressed"] += 1
+            _M_ADVERTS_SUPPRESSED.inc()
             return self._ack_frame() if self.ack_owed else None
         self.seq_out += 1
         self.pending = {
             "seq": self.seq_out,
             "payload": payload,
             "attempt": 0,
-            "deadline": now + self.config.timeout,
+            "deadline": self.clock() + self.config.timeout,
+            "rx_mark": self._payloads_applied,
         }
         self.ack_owed = False
         return encode_frame(self.epoch, self.seq_out, self.last_seen, payload)
@@ -357,6 +420,25 @@ class SyncSession:
     def handle(self, frame_bytes):
         """Processes one incoming frame; returns the inner protocol's patch
         (None for acks/duplicates/shed frames)."""
+        pre = self.begin(frame_bytes)
+        if pre is None:
+            return None
+        # apply BEFORE advancing the seq watermark: a payload the inner
+        # protocol rejects (corrupt/inapplicable) must not be acked, so the
+        # peer's intact retransmission gets a clean retry
+        state, patch = self.driver.receive(self.state, pre["payload"])
+        return self.commit(pre, state, patch)
+
+    def begin(self, frame_bytes):
+        """The envelope half of ``handle``: decodes and validates the
+        frame, processes its ack/epoch side effects, and drops duplicates
+        — everything except applying the payload through the driver.
+        Returns None when there is nothing to apply (ack-only, duplicate,
+        shed), else ``{"seq", "payload"}`` to hand to the inner protocol
+        and then to ``commit``. The serving batcher (serve/batcher.py)
+        uses this split to stage many sessions' payloads into ONE batched
+        farm dispatch; ``handle`` composes the same two halves around an
+        immediate ``driver.receive``."""
         if self.quarantine_cause is not None:
             _M_SHED.inc()
             self.stats["shed"] += 1
@@ -372,6 +454,8 @@ class SyncSession:
                 self._on_peer_restart()
             self.peer_epoch = frame["epoch"]
         if self.pending is not None and frame["ack"] >= self.pending["seq"]:
+            self._acked_payload = self.pending["payload"]
+            self._acked_rx_mark = self.pending["rx_mark"]
             self.pending = None
         payload = frame["payload"]
         if payload is None:
@@ -381,13 +465,19 @@ class SyncSession:
             self.stats["dup_dropped"] += 1
             self.ack_owed = True  # re-ack so the peer stops retransmitting
             return None
-        # apply BEFORE advancing the seq watermark: a payload the inner
-        # protocol rejects (corrupt/inapplicable) must not be acked, so the
-        # peer's intact retransmission gets a clean retry
-        state, patch = self.driver.receive(self.state, payload)
+        return {"seq": frame["seq"], "payload": payload}
+
+    def commit(self, pre, state, patch):
+        """The post-apply half of ``handle``: adopts the inner protocol's
+        new state, advances the seq watermark (the payload is now safe to
+        ack), and runs a watchdog round. Must only be called with the
+        result of a successful ``driver.receive`` of ``begin``'s payload —
+        a rejected payload is NOT committed, so it is never acked and the
+        peer's retransmission retries cleanly."""
         self.state = state
-        self.last_seen = frame["seq"]
+        self.last_seen = pre["seq"]
         self.ack_owed = True
+        self._payloads_applied += 1
         self._watchdog_round()
         return patch
 
@@ -401,6 +491,7 @@ class SyncSession:
         self.stats["peer_restarts"] += 1
         self.last_seen = 0
         self.pending = None  # addressed to the old incarnation; regenerate
+        self._acked_payload = None  # the new incarnation acked nothing
         self.state = dict(
             self.state,
             theirHeads=None, theirHave=None, theirNeed=None,
@@ -435,6 +526,7 @@ class SyncSession:
         self.stats["stalls"] += 1
         _M_WD_ESCALATIONS.inc()
         self.stats["escalations"] += 1
+        self._acked_payload = None  # escalations must retransmit freely
         if self._wd_stage == 0:
             # stage 1 — rebuild the Bloom exchange: clearing sentHashes and
             # lastSentHeads makes the next generate resend its filter and
@@ -464,12 +556,10 @@ class SyncSession:
         return self.quarantine_cause is not None
 
     def _enter_quarantine(self, cause: SyncProtocolError):
-        global _active_quarantined
         self.quarantine_cause = cause
         self.pending = None
         _M_CHQ_ENTERED.inc()
-        _active_quarantined += 1
-        _M_CHQ_ACTIVE.set(_active_quarantined)
+        _set_active_quarantined()
 
     def release(self):
         """Returns a quarantined channel to service with a fresh retry
@@ -478,15 +568,14 @@ class SyncSession:
         after a known network heal so a frame that burned most of its
         budget against the partition is not quarantined by its next
         timeout."""
-        global _active_quarantined
         if self.quarantine_cause is None:
             if self.pending is not None:
                 self.pending["attempt"] = 0
             return
         self.quarantine_cause = None
+        self._acked_payload = None  # post-heal recovery regenerates freely
         _M_CHQ_RELEASED.inc()
-        _active_quarantined = max(0, _active_quarantined - 1)
-        _M_CHQ_ACTIVE.set(_active_quarantined)
+        _set_active_quarantined()
 
     def check(self):
         """Raises ``ChannelQuarantinedError`` if the channel is shed (the

@@ -217,6 +217,22 @@ class ChaosNetwork:
                 dropped += link.clear()
         return dropped
 
+    @property
+    def in_flight(self) -> int:
+        """Frames queued across every link (0 = the network is quiet)."""
+        return sum(link.in_flight for link in self._links.values())
+
+    def next_arrival(self) -> float | None:
+        """Earliest simulated arrival time across every link, or None when
+        nothing is in flight — event-driven harnesses (serve/loadgen.py)
+        jump the clock here instead of ticking through quiet gaps."""
+        times = [
+            t
+            for link in self._links.values()
+            if (t := link.next_arrival()) is not None
+        ]
+        return min(times, default=None)
+
     def stats(self) -> dict:
         return {link.name: link.stats.as_dict() for link in self._links.values()}
 

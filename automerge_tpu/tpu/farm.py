@@ -241,6 +241,16 @@ class FarmApplyResult(list):
             d: o for d, o in enumerate(self.outcomes) if o.status == "quarantined"
         }
 
+    @property
+    def applied(self):
+        """{doc index: DocOutcome} of the docs whose delivery committed
+        (including fallback-walk-served docs) — the symmetric accessor to
+        ``quarantined``, so callers like the serve batcher account
+        outcomes without re-filtering ``outcomes`` by status string."""
+        return {
+            d: o for d, o in enumerate(self.outcomes) if o.status == "applied"
+        }
+
 
 class TpuDocFarm:
     """N documents, one device engine. See module docstring.

@@ -71,6 +71,14 @@ _M_SHED_QUARANTINED = _METRICS.counter(
 )
 
 
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (min 1): the shape-bucket grid for the
+    batched filter kernels. A serving pump calls generate_messages with a
+    different channel count every sweep; without bucketing, every distinct
+    (batch, width) pair costs a fresh XLA compile."""
+    return 1 << (max(n, 1) - 1).bit_length()
+
+
 def filters_from_bytes(blobs):
     """Parses wire-format Bloom filters into padded device tensors:
     (words [B, W] uint32, modulo [B] int32, counts [B] int32). Inverse of
@@ -113,6 +121,10 @@ class SyncFarm:
 
     def __init__(self, farm):
         self.farm = farm
+        # outcome report of the most recent receive_messages farm dispatch
+        # (a FarmApplyResult, or None when the call applied no changes) —
+        # the serve batcher reads .applied/.quarantined off it per flush
+        self.last_apply = None
 
     @staticmethod
     def init_state():
@@ -163,11 +175,21 @@ class SyncFarm:
                 continue
             plans.append(self._plan_generate(d, state))
 
-        # batched `have` filter construction
+        # batched `have` filter construction, pow2-padded in batch and
+        # width so every sweep size shares a few compiled programs (the
+        # padding is masked: zero-count rows serialise to empty filters)
         build_idx = [i for i, p in enumerate(plans) if p.get("build_hashes") is not None]
         if build_idx:
-            xyz, counts = pack_hashes([plans[i]["build_hashes"] for i in build_idx])
-            num_words = int(ceil(xyz.shape[1] * BITS_PER_ENTRY / WORD_BITS)) or 1
+            lists = [plans[i]["build_hashes"] for i in build_idx]
+            width = _pow2(max((len(h) for h in lists), default=1))
+            xyz, counts = pack_hashes(lists, width=width)
+            pad = _pow2(len(lists)) - len(lists)
+            if pad:
+                xyz = np.concatenate(
+                    [xyz, np.zeros((pad,) + xyz.shape[1:], xyz.dtype)]
+                )
+                counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
+            num_words = int(ceil(width * BITS_PER_ENTRY / WORD_BITS)) or 1
             words, modulo = build_filters(xyz, counts, num_words)
             blooms = filters_to_bytes(words, modulo, counts)
             for i, bloom in zip(build_idx, blooms):
@@ -184,12 +206,24 @@ class SyncFarm:
                 blobs.append(plans[i]["query"]["bloom"])
                 cand_lists.append(plans[i]["query"]["hashes"])
             words, modulo, counts = filters_from_bytes(blobs)
-            width = max((len(c) for c in cand_lists), default=1) or 1
-            q = np.zeros((len(blobs), width, 3), np.uint32)
+            # pow2 shape buckets (batch, candidate width, filter words):
+            # padded rows/slots are masked by counts and never read back
+            batch = _pow2(len(blobs))
+            width = _pow2(max((len(c) for c in cand_lists), default=1))
+            w_words = _pow2(words.shape[1])
+            padded_words = np.zeros((batch, w_words), words.dtype)
+            padded_words[: words.shape[0], : words.shape[1]] = words
+            padded_modulo = np.zeros(batch, modulo.dtype)
+            padded_modulo[: modulo.shape[0]] = modulo
+            padded_counts = np.zeros(batch, counts.dtype)
+            padded_counts[: counts.shape[0]] = counts
+            q = np.zeros((batch, width, 3), np.uint32)
             for b, hashes in enumerate(cand_lists):
                 for c, h in enumerate(hashes):
                     q[b, c] = hash_to_xyz(h)
-            contained = np.asarray(query_filters(words, modulo, counts, q))
+            contained = np.asarray(query_filters(
+                padded_words, padded_modulo, padded_counts, q
+            ))
             total_hits = 0
             for b, i in enumerate(query_idx):
                 hits = {
@@ -429,6 +463,7 @@ class SyncFarm:
             d for (d, _, _), msg in zip(channels_msgs, decoded)
             if msg is not None
         ]
+        self.last_apply = None
         if len(set(live_docs)) != len(live_docs):
             return [
                 (s, None) if msg is None else self._receive_one(d, s, msg)
@@ -443,6 +478,7 @@ class SyncFarm:
                 if msg is not None:
                     per_doc[d] = list(msg["changes"])
             patches = farm.apply_changes(per_doc)
+            self.last_apply = patches
 
         results = []
         for (d, state, _), msg in zip(channels_msgs, decoded):
@@ -460,7 +496,9 @@ class SyncFarm:
         if msg["changes"]:
             per_doc = [[] for _ in range(farm.num_docs)]
             per_doc[d] = list(msg["changes"])
-            patch = farm.apply_changes(per_doc)[d]
+            result = farm.apply_changes(per_doc)
+            self.last_apply = result
+            patch = result[d]
         return self._post_receive(d, state, msg, before, patch)
 
     def _post_receive(self, d, state, msg, before_heads, patch):

@@ -257,6 +257,101 @@ def _quick_main():
     sys.exit(0 if ok else 1)
 
 
+def bench_serve(clients, docs, edits, ops, spread, chaos=0.0, poison=0.0,
+                seed=0):
+    """The serving front door under load (README "Serving"): `clients`
+    simulated editors drive an AmServer over per-client chaos links in
+    simulated time (serve/loadgen.py). The batcher turns their sync
+    traffic into dense farm dispatches; the figures of merit are p50/p95/
+    p99 sync latency (simulated ms — what a client feels, batching window
+    included), e2e ops/s (committed ops per HOST second — what the
+    serving stack costs), and batch occupancy (docs per dispatch — the
+    density the batcher exists to create)."""
+    from automerge_tpu.serve.loadgen import LoadConfig, LoadGen
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    per_doc_ops = -(-clients // docs) * edits * ops + 8
+    capacity = 1 << (per_doc_ops - 1).bit_length()
+    farm = TpuDocFarm(docs, capacity=capacity)
+    config = LoadConfig(
+        clients=clients, docs=docs, edits_per_client=edits,
+        ops_per_edit=ops, spread=spread, chaos=chaos, poison=poison,
+        seed=seed,
+    )
+    harness = LoadGen(farm, config)
+    start = time.perf_counter()
+    report = harness.run()
+    elapsed = time.perf_counter() - start
+    surviving_ops = (
+        report["surviving_clients"] * edits * ops
+    )
+    report["host_s"] = round(elapsed, 2)
+    report["e2e_ops_per_sec"] = round(surviving_ops / elapsed) if elapsed else 0
+    report["sim_ops_per_sec"] = (
+        round(surviving_ops / report["simulated_s"])
+        if report["simulated_s"] else 0
+    )
+    return report
+
+
+def _serve_main(quick):
+    """`bench.py --serve [--quick]`: one JSON line of serving figures. In
+    --quick mode (the tier-1 smoke shape, `make serve`) the gate asserts
+    machine-independent properties — everything below runs in simulated
+    time off one seed, so the numbers are reproducible anywhere:
+    convergence of every client's heads, batch occupancy >= the floor,
+    and zero unexplained sheds (no admission rejects without poison)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    floor = float(os.environ.get("BENCH_SERVE_OCCUPANCY_FLOOR", "8"))
+    if quick:
+        clients, docs, edits, ops, spread = 192, 32, 2, 4, 0.4
+        chaos = poison = 0.0
+    else:
+        clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "10000"))
+        docs = int(os.environ.get("BENCH_SERVE_DOCS", "1024"))
+        edits = int(os.environ.get("BENCH_SERVE_EDITS", "2"))
+        ops = int(os.environ.get("BENCH_OPS", "4"))
+        spread = float(os.environ.get("BENCH_SERVE_SPREAD", "2.0"))
+        chaos = float(os.environ.get("BENCH_SERVE_CHAOS", "0"))
+        poison = float(os.environ.get("BENCH_SERVE_POISON", "0"))
+    report = bench_serve(clients, docs, edits, ops, spread,
+                         chaos=chaos, poison=poison)
+    unexplained_sheds = (
+        report["admission"]["rejected_quarantine"]
+        + report["admission"]["shed_mid_window"]
+        if poison == 0 else 0
+    )
+    ok = (
+        report["converged"]
+        and report["occupancy_mean"] >= floor
+        and unexplained_sheds == 0
+    )
+    print(json.dumps({
+        "metric": "served sync throughput (batched front door, e2e ops/sec)",
+        "value": report["e2e_ops_per_sec"],
+        "unit": "ops/sec",
+        "ok": ok,
+        "clients": clients,
+        "docs": docs,
+        "chaos": chaos,
+        "poison": poison,
+        "converged": report["converged"],
+        "surviving_clients": report["surviving_clients"],
+        "quarantined_docs": report["quarantined_docs"],
+        "simulated_s": report["simulated_s"],
+        "host_s": report["host_s"],
+        "sim_ops_per_sec": report["sim_ops_per_sec"],
+        "latency_ms": report["latency_ms"],
+        "dispatches": report["dispatches"],
+        "occupancy_mean": report["occupancy_mean"],
+        "occupancy_floor": floor,
+        "admission": report["admission"],
+        "frames_shed": report["frames_shed"],
+    }))
+    if quick:
+        sys.exit(0 if ok else 1)
+
+
 def bench_faults(num_docs, rounds, ops_per_round, fault_pct, seed=0):
     """Degradation curve of the per-doc fault-isolation layer: batch
     throughput with `fault_pct`% of the docs receiving poisoned deliveries
@@ -590,6 +685,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--serve" in sys.argv:
+        _serve_main(quick="--quick" in sys.argv)
     elif "--quick" in sys.argv:
         _quick_main()
     elif "--faults" in sys.argv:

@@ -1,0 +1,18 @@
+"""AM403 clean fixture: the non-blocking serve event-loop idiom —
+cooperative sleeps, injected clocks, transports owned by asyncio."""
+# amlint: serve-event-loop
+import asyncio
+
+
+async def flush_loop(server, interval):
+    while True:
+        await asyncio.sleep(interval)  # cooperative: yields the loop
+        server.tick()
+
+
+def due(clock, window_start, interval):
+    return clock() - window_start >= interval
+
+
+async def serve(handler, host, port):
+    return await asyncio.start_server(handler, host, port)

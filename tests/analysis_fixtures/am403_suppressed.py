@@ -1,0 +1,8 @@
+"""AM403 suppressed fixture: the batcher's one justified dispatch point."""
+# amlint: serve-event-loop
+
+
+def dispatch(jax, batch):
+    # the flush's single synchronous device readback: every queued doc
+    # pays this latency together, which is the whole point of batching
+    return jax.device_get(batch)  # amlint: disable=AM403 — the batcher's flush dispatch point

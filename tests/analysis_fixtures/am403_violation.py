@@ -1,0 +1,19 @@
+"""AM403 violating fixture: blocking calls inside serve event-loop code."""
+# amlint: serve-event-loop
+import socket
+import time
+from time import sleep
+
+
+def flush_wait(batch, jax):
+    time.sleep(0.05)
+    ready = batch.block_until_ready()
+    return jax.device_get(ready)
+
+
+def dial(host, port):
+    return socket.create_connection((host, port))
+
+
+def nap():
+    sleep(1)

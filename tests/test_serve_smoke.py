@@ -1,0 +1,71 @@
+"""Tier-1 smoke gate for the serving front door (ISSUE 6), mirroring the
+bench-smoke pattern: a small, fully seeded, simulated-time load-harness
+run whose figures are machine-independent (the clock is a ManualClock, so
+scheduling, batching windows and retransmission deadlines replay exactly
+from the seed on any host — only the wall-clock duration varies).
+
+Properties gated (`bench.py --serve --quick` checks the same at a larger
+config; `make serve` runs that):
+- every client's heads converge to the farm's (the whole point of the
+  session multiplexer + batcher pipeline);
+- batch occupancy stays above the floor — the dynamic batcher must keep
+  farm dispatches dense, not degrade to request-per-dispatch;
+- zero unexplained sheds: with no poison and no chaos, nothing may be
+  rejected at admission or dropped from a window.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+OCCUPANCY_FLOOR = 8
+
+_REPORT = None
+
+
+def _smoke():
+    global _REPORT
+    if _REPORT is None:
+        _REPORT = bench.bench_serve(
+            clients=96, docs=24, edits=2, ops=4, spread=0.4,
+        )
+    return _REPORT
+
+
+def test_all_clients_converge():
+    report = _smoke()
+    assert report["converged"], report
+    assert report["unconverged_clients"] == 0
+    assert report["surviving_clients"] == 96
+
+
+def test_batch_occupancy_above_floor():
+    """The batcher must produce dense dispatches: mean docs-with-changes
+    per farm dispatch at the default flush policy stays >= the floor. A
+    regression to per-request dispatching collapses this toward 1."""
+    report = _smoke()
+    assert report["dispatches"] > 0
+    assert report["occupancy_mean"] >= OCCUPANCY_FLOOR, report
+
+
+def test_zero_unexplained_sheds():
+    """No poison, no chaos => nothing may be shed: no admission rejects,
+    no quarantine exclusions, no backpressure, no client-rejected frames."""
+    report = _smoke()
+    assert report["admission"]["rejected_quarantine"] == 0
+    assert report["admission"]["rejected_backpressure"] == 0
+    assert report["admission"]["shed_mid_window"] == 0
+    assert report["frames_shed"] == 0
+    assert report["quarantined_docs"] == 0
+
+
+def test_latency_histogram_populated():
+    """The latency figures the bench reports must come from real samples
+    (first transmission -> ack), not an empty histogram."""
+    report = _smoke()
+    lat = report["latency_ms"]
+    assert lat["samples"] > 0
+    assert lat["p50"] is not None
+    assert lat["p50"] <= lat["p95"] <= lat["p99"]
