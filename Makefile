@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke chaos serve
+.PHONY: lint test native obs-report faults bench-smoke chaos serve decode
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -30,6 +30,13 @@ chaos:
 # "Performance"); also runs as a tier-1 test (tests/test_bench_smoke.py)
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --quick
+
+# columnar decode microbench (cold/warm MB/s, scalar vs vectorized vs
+# native) + mixed-size page-packing report; gates on the vectorized path
+# beating the scalar oracle and >= 80% slab occupancy (README
+# "Performance")
+decode:
+	JAX_PLATFORMS=cpu $(PY) bench.py --decode
 
 # serving front-door demo (README "Serving"): 192 simulated clients over
 # the chaos transport in simulated time through the session multiplexer +

@@ -41,6 +41,9 @@ RULES: dict[str, tuple[str, str]] = {
                          "sort(key=lambda ...) or int()/bool() coercion "
                          "over range-indexed rows (use column ops and a "
                          "precomputed sort-key column)"),
+    "AM106": ("hotpath", "per-byte Python decode loop in a decode hot-path "
+                         "module (vectorize: continuation-bit mask + "
+                         "prefix scan, record-level run expansion)"),
     "AM201": ("tracer", "Python-level control flow on a traced value inside "
                         "jit/pallas-traced code"),
     "AM202": ("tracer", "host-side call (np.*, int()/float(), .item()) on a "

@@ -1,5 +1,5 @@
-"""AM105 — hot-phase hygiene: no per-row Python in the farm's profiled
-hot phases.
+"""AM105/AM106 — hot-phase hygiene: no per-row Python in the farm's
+profiled hot phases, no per-byte Python in the decode hot path.
 
 BENCH_r05 showed the merge farm spending >85% of wall time in host-side
 Python that re-walks state row by row (``visibility`` + ``patch_assembly``
@@ -20,6 +20,17 @@ Scope: modules whose filename stem is in ``HOT_PHASE_STEMS`` (the farm's
 assembly layers), plus any file carrying a ``# amlint: hot-path`` marker.
 Deliberately-cold call sites inside a hot module (per-call table builds,
 debug paths) carry justified ``# amlint: disable=AM105`` suppressions.
+
+AM106 bans the shape the vectorized decode (tpu/decode.py) replaced: a
+``while``/``for`` loop that steps one byte at a time through a buffer —
+a subscript of a buffer-named value (``buf``/``buffer``/``data``/...)
+together with a ``+= 1`` cursor increment in the same loop body. LEB128
+boundary detection is one continuation-bit mask + prefix scan; run
+expansion is a record-level walk plus ``np.repeat`` — per-BYTE Python
+must not creep back into decode modules. Scope: filename stems in
+``DECODE_STEMS`` plus hot-path-marked files; the scalar parity oracle
+(codecs.py) keeps its byte loops under justified suppressions — it IS
+the reference the vector passes are tested against.
 """
 from __future__ import annotations
 
@@ -32,11 +43,23 @@ from .core import FileContext, Finding, dotted_name
 #: visibility, patch_assembly)
 HOT_PHASE_STEMS = frozenset({"farm", "transcode"})
 
+#: modules implementing the decode hot path (AM106): the scalar codec
+#: layer and the vectorized column decode
+DECODE_STEMS = frozenset({"codecs", "decode"})
+
+#: names a per-byte decode loop subscripts (the cursor walks one of these)
+_BUF_NAMES = frozenset({"buf", "buffer", "data", "raw", "chunk", "payload",
+                        "stream"})
+
 _COERCIONS = {"int", "bool"}
 
 
 def _in_scope(ctx: FileContext) -> bool:
     return Path(ctx.path).stem in HOT_PHASE_STEMS or ctx.hot_path_marker
+
+
+def _in_decode_scope(ctx: FileContext) -> bool:
+    return Path(ctx.path).stem in DECODE_STEMS or ctx.hot_path_marker
 
 
 def _is_key_lambda_sort(node: ast.Call) -> str | None:
@@ -89,9 +112,54 @@ def _range_loop_bodies(tree: ast.Module):
                     yield node, [node.elt]
 
 
+def _is_buffer_subscript(node: ast.Subscript) -> bool:
+    base = node.value
+    if isinstance(base, ast.Name):
+        return base.id in _BUF_NAMES
+    if isinstance(base, ast.Attribute):
+        return base.attr in _BUF_NAMES
+    return False
+
+
+def _is_cursor_step(node: ast.AugAssign) -> bool:
+    return (
+        isinstance(node.op, ast.Add)
+        and isinstance(node.value, ast.Constant)
+        and node.value.value == 1
+    )
+
+
+def _check_byte_loops(ctx: FileContext, findings: list) -> None:
+    """AM106: a while/for loop whose body both subscripts a buffer-named
+    value and advances a cursor by one — the per-byte scalar decode shape
+    the vectorized column passes replaced."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        has_subscript = False
+        has_step = False
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Subscript) and _is_buffer_subscript(sub):
+                    has_subscript = True
+                elif isinstance(sub, ast.AugAssign) and _is_cursor_step(sub):
+                    has_step = True
+        if has_subscript and has_step:
+            findings.append(ctx.finding(
+                "AM106", node,
+                "per-byte decode loop in a decode hot-path module: the "
+                "loop walks a buffer one byte at a time — decode the "
+                "column with a masked vector pass (continuation-bit mask "
+                "+ prefix scan, record-level run expansion; see "
+                "tpu/decode.py)",
+            ))
+
+
 def check(ctxs: list[FileContext]) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
+        if _in_decode_scope(ctx):
+            _check_byte_loops(ctx, findings)
         if not _in_scope(ctx):
             continue
         for node in ast.walk(ctx.tree):

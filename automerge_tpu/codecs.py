@@ -34,19 +34,35 @@ class DecodeCache:
     per-delivery state must copy (columnar.decode_change_cached returns a
     shallow copy per hit for exactly that reason).
 
-    Capacity bounds the working set (oldest-used entries evict first).
-    Hits/misses/evictions are counted on the process-wide metrics registry
-    under the instrument names ``<name>.{hits,misses,evictions}``; caches
-    constructed with the same name share one set of instruments.
+    Capacity bounds the working set by entry count; `max_bytes` additionally
+    bounds it by the total size of the cached chunk bytes (the key), so a
+    few huge document chunks cannot pin unbounded host memory however small
+    the entry count stays. Oldest-used entries evict first under either
+    bound. Hits/misses/evictions are counted on the process-wide metrics
+    registry under the instrument names ``<name>.{hits,misses,evictions}``,
+    and ``<name>.bytes`` gauges the bytes currently pinned; caches
+    constructed with the same name share one set of instruments (the bytes
+    gauge aggregates across them).
     """
 
-    __slots__ = ("capacity", "_entries", "_m_hits", "_m_misses", "_m_evictions")
+    __slots__ = ("capacity", "max_bytes", "name", "_entries", "_bytes",
+                 "_m_hits", "_m_misses", "_m_evictions", "_m_bytes")
 
-    def __init__(self, capacity: int, name: str = "codecs.decode_cache"):
+    #: per-name aggregate of pinned bytes across cache instances (the two
+    #: module-level caches share the default name and one gauge)
+    _name_bytes: dict = {}
+
+    def __init__(self, capacity: int, name: str = "codecs.decode_cache",
+                 max_bytes: int | None = None):
         if capacity <= 0:
             raise ValueError("DecodeCache capacity must be positive")  # amlint: disable=AM401 — API-usage validation
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError("DecodeCache max_bytes must be positive")  # amlint: disable=AM401 — API-usage validation
         self.capacity = capacity
+        self.max_bytes = max_bytes
+        self.name = name
         self._entries: dict = {}
+        self._bytes = 0
         metrics = get_metrics()
         self._m_hits = metrics.counter(
             f"{name}.hits", "decode calls served from the LRU"
@@ -57,9 +73,28 @@ class DecodeCache:
         self._m_evictions = metrics.counter(
             f"{name}.evictions", "entries dropped by the LRU capacity bound"
         )
+        self._m_bytes = metrics.gauge(
+            f"{name}.bytes", "chunk bytes currently pinned by the LRU"
+        )
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    @staticmethod
+    def _cost(key) -> int:
+        """Byte cost of one entry: the chunk bytes ARE the key, and the
+        decoded value's size tracks the chunk size, so the key length is
+        the budgeted proxy."""
+        try:
+            return len(key)
+        except TypeError:
+            return 0
+
+    def _account(self, delta: int) -> None:
+        self._bytes += delta
+        total = self._name_bytes.get(self.name, 0) + delta
+        self._name_bytes[self.name] = total
+        self._m_bytes.set(total)
 
     def get(self, key):
         """The cached value for `key` (refreshing its recency), else None."""
@@ -74,13 +109,24 @@ class DecodeCache:
     def put(self, key, value) -> None:
         if key in self._entries:
             self._entries.pop(key)
+            self._account(-self._cost(key))
         elif len(self._entries) >= self.capacity:
-            self._entries.pop(next(iter(self._entries)))  # oldest entry
-            self._m_evictions.inc()
+            self._evict_oldest()
         self._entries[key] = value
+        self._account(self._cost(key))
+        if self.max_bytes is not None:
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                self._evict_oldest()
+
+    def _evict_oldest(self) -> None:
+        oldest = next(iter(self._entries))
+        self._entries.pop(oldest)
+        self._account(-self._cost(oldest))
+        self._m_evictions.inc()
 
     def clear(self) -> None:
         self._entries.clear()
+        self._account(-self._bytes)
 
 
 def hex_to_bytes(value: str) -> bytes:
@@ -220,6 +266,9 @@ class Decoder:
         """Reads raw LEB128 bytes (up to 10); returns (unsigned_value, shift, last_byte)."""
         result = 0
         shift = 0
+        # amlint: disable=AM106 — scalar parity oracle: the per-byte walk
+        # the vectorized passes (tpu/decode.py) are differentially tested
+        # against, and the canonical raiser for malformed varints
         while self.offset < len(self.buf):
             byte = self.buf[self.offset]
             if shift == 63 and byte > 1 and byte != 0x7F:
@@ -509,6 +558,7 @@ class RLEDecoder(Decoder):
             for _ in range(num):
                 self.skip(self.read_uint53())
         else:
+            # amlint: disable=AM106 — scalar parity oracle (see _read_leb_bytes)
             while num > 0 and self.offset < len(self.buf):
                 if not (self.buf[self.offset] & 0x80):
                     num -= 1

@@ -743,14 +743,293 @@ def decode_change_columns(buffer):
 _CHANGE_COLUMN_IDS = {cid: name for name, cid in CHANGE_COLUMNS}
 
 
+def ops_from_column_arrays(arrs, actor_ids):
+    """Assembles backend-form change ops from dense column arrays
+    (struct-of-arrays) — the shared back half of the array-at-a-time decode
+    paths (native/codecs.cpp and the vectorized passes in tpu/decode.py).
+
+    `arrs` maps column names (objActor, objCtr, keyActor, keyCtr, idActor,
+    idCtr, action, valLen, chldActor, chldCtr, predNum, predActor, predCtr)
+    to int64 arrays with nulls as ``native.NULL_SENTINEL``, plus "insert"
+    (bool array), "keyStr" as a ``(blob bytes, offsets int64[n, 2])`` pair
+    (``(-1, -1)`` rows are null) and "valRaw" raw bytes. Missing/short
+    columns are padded with nulls exactly like the generic decoder chain
+    reading exhausted columns. Returns the op list, or None when the arrays
+    are degenerate for the fast path (the caller falls back to the per-op
+    decoder chain, which raises the canonical error). Output is identical
+    to decode_ops(decode_columns(...)) — differentially tested."""
+    from .native import NULL_SENTINEL
+
+    empty_i = np.empty(0, np.int64)
+    obj_actor = arrs.get("objActor", empty_i)
+    obj_ctr = arrs.get("objCtr", empty_i)
+    key_actor = arrs.get("keyActor", empty_i)
+    key_ctr = arrs.get("keyCtr", empty_i)
+    id_actor = arrs.get("idActor", empty_i)
+    id_ctr = arrs.get("idCtr", empty_i)
+    action = arrs.get("action", empty_i)
+    val_len = arrs.get("valLen", empty_i)
+    chld_actor = arrs.get("chldActor", empty_i)
+    chld_ctr = arrs.get("chldCtr", empty_i)
+    pred_num = arrs.get("predNum", empty_i)
+    pred_actor = arrs.get("predActor", empty_i)
+    pred_ctr = arrs.get("predCtr", empty_i)
+    insert = arrs.get("insert", np.empty(0, bool))
+    key_blob, key_offs = arrs.get("keyStr", (b"", np.empty((0, 2), np.int64)))
+    val_raw = arrs.get("valRaw", b"")
+
+    n_rows = max(
+        obj_actor.size, obj_ctr.size, key_actor.size, key_ctr.size,
+        id_actor.size, id_ctr.size, action.size, val_len.size,
+        chld_actor.size, chld_ctr.size, pred_num.size, insert.size,
+        key_offs.shape[0],
+    )
+    NULLS = NULL_SENTINEL
+
+    def pad(arr, fill=NULLS):
+        if arr.size >= n_rows:
+            return arr
+        out = np.full(n_rows, fill, arr.dtype)
+        out[: arr.size] = arr
+        return out
+
+    obj_actor, obj_ctr = pad(obj_actor), pad(obj_ctr)
+    key_actor, key_ctr = pad(key_actor), pad(key_ctr)
+    action, val_len = pad(action), pad(val_len)
+    chld_actor, chld_ctr = pad(chld_actor), pad(chld_ctr)
+    pred_num = pad(pred_num)
+    insert = (
+        np.concatenate([insert, np.zeros(n_rows - insert.size, bool)])
+        if insert.size < n_rows
+        else insert
+    )
+
+    # valRaw slices: cumulative (valLen >> 4) with nulls contributing 0
+    sizes = np.where(val_len == NULLS, 0, val_len >> 4)
+    val_ends = np.cumsum(sizes)
+    val_starts = val_ends - sizes
+    if val_ends.size and val_ends[-1] > len(val_raw):
+        return None
+
+    num_actors = len(actor_ids)
+    total_preds = int(np.sum(np.where(pred_num == NULLS, 0, pred_num)))
+    if pred_actor.size < total_preds or pred_ctr.size < total_preds:
+        return None
+
+    # per-column masked value pass: every set/inc row's (valLen tag, valRaw
+    # slice) pair decodes in bulk — varint payloads through one [rows, 8]
+    # byte-matrix scan, doubles through one view cast — instead of a
+    # Decoder object per row (decode_value). Rows the pass cannot prove
+    # well-formed decode through decode_value itself, which raises the
+    # canonical error.
+    set_inc = (action == _ACTION_SET_IDX) | (action == _ACTION_INC_IDX)
+    values = _decode_values_bulk(
+        val_len, sizes, val_starts, val_raw, set_inc, NULLS
+    )
+
+    # pred column: the strings, actor-range check and ascending check all
+    # run as one pass over the flat pred rows before any op materialises
+    used_preds = pred_actor[:total_preds]
+    used_pred_ctr = pred_ctr[:total_preds]
+    if used_preds.size and int(used_preds.max()) >= num_actors:
+        bad = int(used_preds[used_preds >= num_actors][0])
+        raise DecodeError(f"No actor index {bad}")
+    pred_strs = [
+        f"{c}@{actor_ids[a]}"
+        for c, a in zip(used_pred_ctr.tolist(), used_preds.tolist())
+    ]
+    pred_counts = np.where(pred_num == NULLS, 0, pred_num)
+    pred_bounds = np.zeros(n_rows + 1, np.int64)
+    np.cumsum(pred_counts, out=pred_bounds[1:])
+    if total_preds:
+        # ascending within each op's pred group, on (ctr, actorId string)
+        row_of = np.repeat(np.arange(n_rows), pred_counts)
+        same = row_of[1:] == row_of[:-1]
+        for j in np.nonzero(same)[0]:
+            a = (int(used_pred_ctr[j]), actor_ids[int(used_preds[j])])
+            b = (int(used_pred_ctr[j + 1]), actor_ids[int(used_preds[j + 1])])
+            if a >= b:
+                raise DecodeError("operation IDs are not in ascending order")
+    pred_bounds_l = pred_bounds.tolist()
+
+    # plain-Python row materialisation: numpy scalar indexing costs more
+    # than the dict build itself at this row count, so columns convert to
+    # lists once and the loop runs on ints
+    obj_actor_l = obj_actor.tolist()
+    obj_ctr_l = obj_ctr.tolist()
+    key_actor_l = key_actor.tolist()
+    key_ctr_l = key_ctr.tolist()
+    action_l = action.tolist()
+    chld_actor_l = chld_actor.tolist()
+    chld_ctr_l = chld_ctr.tolist()
+    insert_l = insert.tolist()
+    key_offs_l = key_offs.tolist()
+    num_actions = len(ACTIONS)
+
+    ops = []
+    key_n = len(key_offs_l)
+    key_memo: dict = {}  # (start, end) -> decoded str: RLE keys repeat
+    obj_memo: dict = {}
+    for i in range(n_rows):
+        oa, oc = obj_actor_l[i], obj_ctr_l[i]
+        if oc == NULLS:
+            obj = "_root"
+        else:
+            obj = obj_memo.get(oc * num_actors + oa if oa != NULLS else None)
+            if obj is None:
+                if oa == NULLS or oa >= num_actors:
+                    raise DecodeError(f"No actor index {oa}")
+                obj = f"{oc}@{actor_ids[oa]}"
+                obj_memo[oc * num_actors + oa] = obj
+        ks = None
+        if i < key_n and key_offs_l[i][0] >= 0:
+            span = (key_offs_l[i][0], key_offs_l[i][1])
+            ks = key_memo.get(span)
+            if ks is None:
+                ks = key_blob[span[0]:span[1]].decode("utf-8", "surrogatepass")
+                key_memo[span] = ks
+        if ks is not None:
+            elem_id = None
+        elif key_ctr_l[i] != NULLS and key_ctr_l[i] == 0:
+            elem_id = "_head"
+        else:
+            kc, ka = key_ctr_l[i], key_actor_l[i]
+            if kc == NULLS or ka == NULLS:
+                return None  # degenerate key row: defer to the generic path
+            if ka >= num_actors:
+                raise DecodeError(f"No actor index {ka}")
+            elem_id = f"{kc}@{actor_ids[ka]}"
+        act = action_l[i] if action_l[i] != NULLS else None
+        act_name = ACTIONS[act] if act is not None and act < num_actions else act
+        if elem_id is not None:
+            op = {"obj": obj, "elemId": elem_id, "action": act_name}
+        else:
+            op = {"obj": obj, "key": ks, "action": act_name}
+        op["insert"] = insert_l[i]
+        if act_name in ("set", "inc"):
+            value, datatype = values[i]
+            op["value"] = value
+            if datatype is not None:
+                op["datatype"] = datatype
+        cc, ca = chld_ctr_l[i], chld_actor_l[i]
+        if (cc == NULLS) != (ca == NULLS):
+            raise DecodeError(
+                "Mismatched child columns: "
+                f"{None if cc == NULLS else cc} and "
+                f"{None if ca == NULLS else ca}"
+            )
+        if cc != NULLS:
+            if ca >= num_actors:
+                raise DecodeError(f"No actor index {ca}")
+            op["child"] = f"{cc}@{actor_ids[ca]}"
+        op["pred"] = pred_strs[pred_bounds_l[i]:pred_bounds_l[i + 1]]
+        ops.append(op)
+    return ops
+
+
+_ACTION_SET_IDX = ACTIONS.index("set")
+_ACTION_INC_IDX = ACTIONS.index("inc")
+
+#: valLen type tags whose payload is a single LEB128 varint
+_VARINT_TAG_DATATYPE = {
+    ValueType.LEB128_UINT: "uint",
+    ValueType.LEB128_INT: "int",
+    ValueType.COUNTER: "counter",
+    ValueType.TIMESTAMP: "timestamp",
+}
+
+
+def _decode_values_bulk(val_len, sizes, val_starts, val_raw, mask, NULLS):
+    """Bulk decode_value over the (valLen, valRaw) columns: returns a list
+    with ``(value, datatype)`` at every row where `mask` is set (None
+    elsewhere). The varint-tagged rows decode through one masked byte-
+    matrix pass; IEEE754 rows through one view cast; rows the vector pass
+    cannot prove well-formed fall through to decode_value per row, which
+    produces the canonical value or error."""
+    n = val_len.shape[0]
+    out = [None] * n
+    idx = np.nonzero(mask)[0]
+    if idx.size == 0:
+        return out
+    tags = np.where(val_len == NULLS, 0, val_len)[idx]
+    t = tags % 16
+    starts = val_starts[idx]
+    szs = sizes[idx]
+
+    special = tags <= ValueType.TRUE  # NULL / FALSE / TRUE full tags
+    for j in np.nonzero(special)[0]:
+        out[idx[j]] = ((None, False, True)[tags[j]], None)
+
+    is_varint = ~special & (
+        (t == ValueType.LEB128_UINT) | (t == ValueType.LEB128_INT)
+        | (t == ValueType.COUNTER) | (t == ValueType.TIMESTAMP)
+    )
+    hard = np.zeros(idx.shape[0], bool)
+    raw_arr = np.frombuffer(val_raw, np.uint8)
+    if is_varint.any() and raw_arr.size == 0:
+        hard[is_varint] = True  # zero-size varint slices: canonical error
+        is_varint[:] = False
+    if is_varint.any():
+        v = np.nonzero(is_varint)[0]
+        cols = np.arange(8)
+        pos = starts[v, None] + cols[None, :]
+        in_slice = cols[None, :] < np.minimum(szs[v], 8)[:, None]
+        b = np.where(
+            in_slice, raw_arr[np.minimum(pos, raw_arr.size - 1)], 0
+        ).astype(np.int64)
+        is_end = ((b & 0x80) == 0) & in_slice
+        has_end = is_end.any(axis=1)
+        first_end = is_end.argmax(axis=1)
+        keep = cols[None, :] <= first_end[:, None]
+        payload = (b & 0x7F) * keep
+        u = (payload << (7 * cols)[None, :]).sum(axis=1)
+        lengths = first_end + 1
+        last = b[np.arange(v.shape[0]), first_end]
+        sgn = ((last & 0x40) != 0).astype(np.int64)
+        s = u - (sgn << (7 * lengths))
+        signed_tag = t[v] != ValueType.LEB128_UINT
+        vals = np.where(signed_tag, s, u)
+        in_range = np.where(
+            signed_tag,
+            (vals >= MIN_SAFE_INTEGER) & (vals <= MAX_SAFE_INTEGER),
+            u <= MAX_SAFE_INTEGER,
+        )
+        ok = has_end & in_range
+        hard[v[~ok]] = True
+        vals_l = vals.tolist()
+        for k, j in enumerate(v):
+            if ok[k]:
+                out[idx[j]] = (vals_l[k], _VARINT_TAG_DATATYPE[int(t[j])])
+
+    is_f64 = ~special & (t == ValueType.IEEE754)
+    if is_f64.any():
+        v = np.nonzero(is_f64)[0]
+        exact = szs[v] == 8
+        hard[v[~exact]] = True  # canonical "Invalid length" via decode_value
+        v = v[exact]
+        if v.size:
+            mat = raw_arr[starts[v, None] + np.arange(8)[None, :]]
+            floats = mat.copy().view("<f8").ravel().tolist()
+            for k, j in enumerate(v):
+                out[idx[j]] = (floats[k], "float64")
+
+    rest = ~special & ~is_varint & ~is_f64
+    for j in np.nonzero(rest | hard)[0]:
+        if out[idx[j]] is None or hard[j]:
+            decoded = decode_value(
+                int(tags[j]), val_raw[starts[j]:starts[j] + szs[j]]
+            )
+            out[idx[j]] = (decoded["value"], decoded.get("datatype"))
+    return out
+
+
 def _native_change_ops(cols, actor_ids):
     """Array-at-a-time change-op decoding through the native column codecs
     (native/codecs.cpp); returns None when the fast path does not apply
     (library missing, unknown columns present). ~20x faster than the
     per-op decoder chain for bulk applyChanges ingest: each column is
     decoded to a dense array in one native call and the op dicts are
-    assembled by plain indexing. Output is identical to
-    decode_ops(decode_columns(...)) — differentially tested."""
+    assembled by ops_from_column_arrays."""
     from . import native
 
     if not native.available():
@@ -763,7 +1042,6 @@ def _native_change_ops(cols, actor_ids):
         by_name[name] = bytes(buf)
 
     empty = b""
-    n_rows = 0
 
     def ints(name, kind, max_count=None):
         """Decodes an int column fully; returns int64 array (nulls =
@@ -786,135 +1064,49 @@ def _native_change_ops(cols, actor_ids):
         raise AssertionError
 
     try:
-        obj_actor = ints("objActor", "rle")
-        obj_ctr = ints("objCtr", "rle")
-        key_actor = ints("keyActor", "rle")
-        key_ctr = ints("keyCtr", "delta")
-        id_actor = ints("idActor", "rle")
-        id_ctr = ints("idCtr", "delta")
-        action = ints("action", "rle")
-        val_len = ints("valLen", "rle")
-        chld_actor = ints("chldActor", "rle")
-        chld_ctr = ints("chldCtr", "delta")
-        pred_num = ints("predNum", "rle")
-        pred_actor = ints("predActor", "rle")
-        pred_ctr = ints("predCtr", "delta")
-        insert = (
-            native.bool_decode(by_name["insert"])
-            if by_name.get("insert")
-            else np.empty(0, bool)
-        )
-        if by_name.get("keyStr"):
-            key_blob, key_offs = native.strrle_decode(by_name["keyStr"])
-        else:
-            key_blob, key_offs = b"", np.empty((0, 2), np.int64)
+        arrs = {
+            "objActor": ints("objActor", "rle"),
+            "objCtr": ints("objCtr", "rle"),
+            "keyActor": ints("keyActor", "rle"),
+            "keyCtr": ints("keyCtr", "delta"),
+            "idActor": ints("idActor", "rle"),
+            "idCtr": ints("idCtr", "delta"),
+            "action": ints("action", "rle"),
+            "valLen": ints("valLen", "rle"),
+            "chldActor": ints("chldActor", "rle"),
+            "chldCtr": ints("chldCtr", "delta"),
+            "predNum": ints("predNum", "rle"),
+            "predActor": ints("predActor", "rle"),
+            "predCtr": ints("predCtr", "delta"),
+            "insert": (
+                native.bool_decode(by_name["insert"])
+                if by_name.get("insert")
+                else np.empty(0, bool)
+            ),
+            "keyStr": (
+                native.strrle_decode(by_name["keyStr"])
+                if by_name.get("keyStr")
+                else (b"", np.empty((0, 2), np.int64))
+            ),
+            "valRaw": by_name.get("valRaw", empty),
+        }
     except ValueError:
         return None  # malformed for the fast path: let the generic path raise
+    return ops_from_column_arrays(arrs, actor_ids)
 
-    n_rows = max(
-        obj_actor.size, obj_ctr.size, key_actor.size, key_ctr.size,
-        id_actor.size, id_ctr.size, action.size, val_len.size,
-        chld_actor.size, chld_ctr.size, pred_num.size, insert.size,
-        key_offs.shape[0],
-    )
-    NULLS = native.NULL_SENTINEL
 
-    def pad(arr, fill=NULLS):
-        if arr.size >= n_rows:
-            return arr
-        out = np.full(n_rows, fill, arr.dtype)
-        out[: arr.size] = arr
-        return out
+# Vectorized decode backend (tpu/decode.py): registered by the device layer
+# when it loads, so decode_change gains the masked-vector-pass fast path on
+# hosts without the native library WITHOUT this host-only module importing
+# tpu/ (amlint AM301). Signature matches _native_change_ops.
+_VECTOR_DECODER = None
 
-    obj_actor, obj_ctr = pad(obj_actor), pad(obj_ctr)
-    key_actor, key_ctr = pad(key_actor), pad(key_ctr)
-    action, val_len = pad(action), pad(val_len)
-    chld_actor, chld_ctr = pad(chld_actor), pad(chld_ctr)
-    pred_num = pad(pred_num)
-    insert = (
-        np.concatenate([insert, np.zeros(n_rows - insert.size, bool)])
-        if insert.size < n_rows
-        else insert
-    )
 
-    val_raw = by_name.get("valRaw", empty)
-    # valRaw slices: cumulative (valLen >> 4) with nulls contributing 0
-    sizes = np.where(val_len == NULLS, 0, val_len >> 4)
-    val_ends = np.cumsum(sizes)
-    val_starts = val_ends - sizes
-    if val_ends.size and val_ends[-1] > len(val_raw):
-        return None
-
-    num_actors = len(actor_ids)
-    total_preds = int(np.sum(np.where(pred_num == NULLS, 0, pred_num)))
-    if pred_actor.size < total_preds or pred_ctr.size < total_preds:
-        return None
-
-    ops = []
-    pi = 0
-    key_n = key_offs.shape[0]
-    for i in range(n_rows):
-        oa, oc = obj_actor[i], obj_ctr[i]
-        if oc == NULLS:
-            obj = "_root"
-        else:
-            if oa == NULLS or oa >= num_actors:
-                raise DecodeError(f"No actor index {oa}")
-            obj = f"{oc}@{actor_ids[oa]}"
-        ks = None
-        if i < key_n and key_offs[i, 0] >= 0:
-            ks = key_blob[key_offs[i, 0]:key_offs[i, 1]].decode(
-                "utf-8", "surrogatepass"
-            )
-        if ks is not None:
-            elem_id = None
-        elif key_ctr[i] != NULLS and key_ctr[i] == 0:
-            elem_id = "_head"
-        else:
-            if key_ctr[i] == NULLS or key_actor[i] == NULLS:
-                return None  # degenerate key row: defer to the generic path
-            if key_actor[i] >= num_actors:
-                raise DecodeError(f"No actor index {key_actor[i]}")
-            elem_id = f"{key_ctr[i]}@{actor_ids[key_actor[i]]}"
-        act = int(action[i]) if action[i] != NULLS else None
-        act_name = ACTIONS[act] if act is not None and act < len(ACTIONS) else act
-        if elem_id is not None:
-            op = {"obj": obj, "elemId": elem_id, "action": act_name}
-        else:
-            op = {"obj": obj, "key": ks, "action": act_name}
-        op["insert"] = bool(insert[i])
-        if act_name in ("set", "inc"):
-            tag = int(val_len[i]) if val_len[i] != NULLS else 0
-            decoded = decode_value(tag, val_raw[val_starts[i]:val_ends[i]])
-            op["value"] = decoded["value"]
-            if decoded.get("datatype") is not None:
-                op["datatype"] = decoded["datatype"]
-        if (chld_ctr[i] == NULLS) != (chld_actor[i] == NULLS):
-            raise DecodeError(
-                "Mismatched child columns: "
-                f"{None if chld_ctr[i] == NULLS else chld_ctr[i]} and "
-                f"{None if chld_actor[i] == NULLS else chld_actor[i]}"
-            )
-        if chld_ctr[i] != NULLS:
-            if chld_actor[i] >= num_actors:
-                raise DecodeError(f"No actor index {chld_actor[i]}")
-            op["child"] = f"{chld_ctr[i]}@{actor_ids[chld_actor[i]]}"
-        np_ = int(pred_num[i]) if pred_num[i] != NULLS else 0
-        pred = []
-        last = None
-        for _ in range(np_):
-            pa, pc = pred_actor[pi], pred_ctr[pi]
-            pi += 1
-            if pa >= num_actors:
-                raise DecodeError(f"No actor index {pa}")
-            key = (int(pc), actor_ids[pa])
-            if last is not None and last >= key:
-                raise DecodeError("operation IDs are not in ascending order")
-            last = key
-            pred.append(f"{pc}@{actor_ids[pa]}")
-        op["pred"] = pred
-        ops.append(op)
-    return ops
+def set_vector_decoder(fn) -> None:
+    """Registers `fn(cols, actor_ids) -> ops | None` as the vectorized
+    change-op decode backend (see tpu/decode.py)."""
+    global _VECTOR_DECODER
+    _VECTOR_DECODER = fn
 
 
 def decode_change(buffer):
@@ -922,6 +1114,8 @@ def decode_change(buffer):
     change = decode_change_columns(buffer)
     cols = [(c["columnId"], c["buffer"]) for c in change["columns"]]
     ops = _native_change_ops(cols, change["actorIds"])
+    if ops is None and _VECTOR_DECODER is not None:
+        ops = _VECTOR_DECODER(cols, change["actorIds"])
     if ops is None:
         ops = decode_ops(decode_columns(cols, change["actorIds"], CHANGE_COLUMNS), False)
     change["ops"] = ops
@@ -936,13 +1130,20 @@ def decode_change(buffer):
 # derive metadata for every candidate every round) is parsed ONCE. Keyed by
 # the raw chunk bytes — the change hash is sha256 over those bytes, so the
 # key identifies the change exactly. Both caches share one metric family:
-# codecs.decode_cache.{hits,misses,evictions}.
+# codecs.decode_cache.{hits,misses,evictions,bytes}. Entry counts bound the
+# working set; AM_DECODE_CACHE_BYTES (default 64 MiB, split across both)
+# bounds pinned host memory so a few huge chunks cannot exhaust it.
 
+_DECODE_CACHE_BYTES = int(
+    os.environ.get("AM_DECODE_CACHE_BYTES", str(64 << 20))
+)
 _DECODED_CHANGE_CACHE = DecodeCache(
-    int(os.environ.get("AM_DECODE_CACHE_CHANGES", "8192"))
+    int(os.environ.get("AM_DECODE_CACHE_CHANGES", "8192")),
+    max_bytes=_DECODE_CACHE_BYTES // 2,
 )
 _DECODED_META_CACHE = DecodeCache(
-    int(os.environ.get("AM_DECODE_CACHE_METAS", "16384"))
+    int(os.environ.get("AM_DECODE_CACHE_METAS", "16384")),
+    max_bytes=_DECODE_CACHE_BYTES // 2,
 )
 
 

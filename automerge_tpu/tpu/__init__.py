@@ -26,4 +26,5 @@ from .engine import (  # noqa: E402
     pack_opid,
     unpack_opid,
 )
+from . import decode  # noqa: E402, F401  (registers the vectorized decode backend)
 from .transcode import BatchTranscoder  # noqa: E402

@@ -66,17 +66,17 @@ _M_STATE_GROWS = _METRICS.counter(
 )
 
 
-def _dispatch(jitted, *args):
+def _dispatch(jitted, *args, **kwargs):
     """Runs a jitted entry point, classifying the call as a jit cache hit
     or a recompile by the growth of the function's compile cache across the
     call. This is the single device-dispatch funnel for the engine, so the
     recompile-storm and dispatch-count metrics cover every merge and
     visibility program; with metrics disabled it degrades to a plain call."""
     if not _METRICS.enabled:
-        return jitted(*args)
+        return jitted(*args, **kwargs)
     size_fn = getattr(jitted, "_cache_size", None)
     before = size_fn() if size_fn is not None else -1
-    out = jitted(*args)
+    out = jitted(*args, **kwargs)
     _M_DISPATCHES.inc()
     if size_fn is not None:
         grew = size_fn() - before
@@ -342,66 +342,292 @@ def _gather_rows(visible, totals, idx):
     return visible.reshape(-1)[idx], totals.reshape(-1)[idx]
 
 
-class BatchedMapEngine:
-    """Host-side driver for the batched map/counter engine.
+# page-storage metrics: the slab's figure of merit (farm.pages.occupancy
+# replaces pad-waste as the HBM measure — see paging.py)
+_M_PAGES_ALLOC = _METRICS.gauge(
+    "farm.pages.allocated", "slab pages currently owned by documents"
+)
+_M_PAGES_FREE = _METRICS.gauge(
+    "farm.pages.free", "slab pages on the allocator free list"
+)
+_M_PAGES_OCC = _METRICS.gauge(
+    "farm.pages.occupancy", "live op rows / allocated page cells"
+)
 
-    Maintains the dense device state for a batch of documents. The capacity
-    doubles when a merge would overflow, bucketing shapes by powers of two so
-    recompiles are amortised. ``version`` counts committed merges; the
-    visibility pytree is memoised per version so that repeated reads between
-    merges (patch assembly, whole-doc scans, scoped readbacks) cost one
-    device dispatch per merge, not one per read.
+# imported mid-module: paging.py needs the kernel functions above, the
+# driver below needs paging's slab programs — the split keeps kernels and
+# storage layout in separate files without a third module
+from .paging import (  # noqa: E402
+    PageAllocator,
+    grow_slab,
+    make_empty_slab,
+    paged_apply_ops,
+    paged_dense_view,
+    paged_probe_ops,
+    paged_visible_plain,
+    paged_visible_ranked,
+)
+
+
+class BatchedMapEngine:
+    """Host-side driver for the batched map/counter engine over ragged
+    paged op storage (paging.py).
+
+    Documents' op rows live in fixed-size pages of one shared device slab
+    (per-doc page table + length on the host). A merge gathers only the
+    ACTIVE documents' rows into a pow2-bucketed dense working view, runs
+    the unchanged merge kernel, and scatters the result back through the
+    new page map — one XLA program, shapes bucketed by (active docs,
+    largest active doc), so a farm of wildly different doc sizes neither
+    pays largest-doc HBM per doc nor recompiles the whole farm when one
+    document grows. ``version`` counts committed merges; visibility
+    pytrees are memoised per (version, doc subset, actor rank) so repeated
+    reads between merges cost one dispatch each.
     """
 
-    def __init__(self, num_docs: int, capacity: int = 1024):
+    def __init__(self, num_docs: int, capacity: int = 1024,
+                 page_size: int | None = None):
+        import os
+
         self.num_docs = num_docs
-        self.capacity = capacity
-        self.state = make_empty_state(num_docs, capacity)
+        self.capacity = capacity  # legacy sizing hint; storage is paged
+        # the dense WORKING width (gather/merge/visibility views) never
+        # shrinks below the caller's sizing hint and ratchets up with the
+        # largest doc: stable pow2 shapes keep the program cache warm (the
+        # hint does NOT reserve HBM — the slab allocates by page)
+        self._width_floor = self._pow2(min(capacity, 1 << 13))
+        page_size = page_size or int(os.environ.get("AM_PAGE_SIZE", "64"))
+        # the slab starts at the caller's sizing hint (num_docs x capacity
+        # rows) and grows in pow2 jumps: every distinct slab size is a
+        # compiled-program shape, so a hint-sized farm never recompiles in
+        # the steady state, while farms of mostly-small docs simply leave
+        # pages on the free list (allocation is per page, the hint only
+        # sizes the arena)
+        hint_pages = (num_docs * min(capacity, 1 << 13)) // page_size
+        self.pages = PageAllocator(
+            page_size, initial_pages=max(4, min(hint_pages, 1 << 17))
+        )
+        self.slab = make_empty_slab(self.pages.num_pages * page_size)
+        self.page_table: list[list] = [[] for _ in range(num_docs)]
+        self.lengths = np.zeros(num_docs, np.int64)
         self.version = 0
-        self._vis_memo = None  # ((version, rank_bytes), visibility pytree)
+        self._vis_memo: dict = {}
 
-    def apply_batch(self, changes: ChangeOpsBatch) -> BatchedDocState:
+    @staticmethod
+    def _pow2(n) -> int:
+        return 1 << max(0, int(n) - 1).bit_length()
+
+    def _width(self, needed: int) -> int:
+        """Dense working width for `needed` rows: pow2-bucketed (never
+        below one page) with the never-shrinking floor, so steady-state
+        dispatches reuse one compiled shape instead of recompiling at
+        every doubling."""
+        width = max(self._pow2(needed), self._width_floor,
+                    self.pages.page_size)
+        self._width_floor = width
+        return width
+
+    def _page_map(self, tables, width, a_pad, fill):
+        """[a_pad, width / P] PAGE indices: slot j of doc k names the slab
+        page holding its rows [j*P, (j+1)*P), else `fill` (0 = the PAD
+        page for gathers, num_pages = dropped for scatters). Device moves
+        are whole contiguous pages; the page-tail invariant (paging.py)
+        makes per-row masking unnecessary."""
+        npg = width // self.pages.page_size
+        mat = np.full((a_pad, npg), fill, np.int32)
+        for k, pt in enumerate(tables):
+            n = min(len(pt), npg)
+            if n:
+                mat[k, :n] = pt[:n]
+        return mat
+
+    def apply_batch(self, changes: ChangeOpsBatch, docs=None, counts=None):
+        """Merges `changes` into the slab. `docs` names the documents the
+        batch rows belong to (None = all docs, the legacy full-farm shape);
+        rows past ``len(docs)`` are pow2 padding. `counts` gives each doc's
+        real (non-pad) row count — passed by the farm, derived from the
+        batch otherwise."""
         _fault_point("engine.apply_batch", changes=changes)
-        needed = int(jnp.max(self.state.num_ops)) + changes.key.shape[1]
-        while needed > self.capacity:
-            self.capacity *= 2
-            self.state = _grow_state(self.state, self.capacity)
-            _M_STATE_GROWS.inc()
-        self.state = _dispatch(batched_apply_ops, self.state, changes)
-        self.version += 1
-        self._vis_memo = None
-        return self.state
+        docs = (
+            list(range(self.num_docs)) if docs is None
+            else [int(d) for d in docs]
+        )
+        if not docs:
+            return
+        a_pad, m = changes.key.shape
+        assert a_pad >= len(docs)
+        if counts is None:
+            counts = np.asarray(changes.key != PAD_KEY).sum(axis=1)[: len(docs)]
+        counts = np.asarray(counts, np.int64)
+        old_lens = self.lengths[docs]
+        new_lens = old_lens + counts
+        width = self._width(int(old_lens.max()) + m)
+        P = self.pages.page_size
 
-    def visible_state(self, actor_rank=None):
-        """Device-resident visibility pytree (see batched_visible_state),
-        memoised per (state version, actor-rank table)."""
+        old_tables = [self.page_table[d] for d in docs]
+        gidx = self._page_map(old_tables, width, a_pad, fill=0)
+
+        extra = [
+            self.pages.pages_for(int(n)) - len(t)
+            for n, t in zip(new_lens, old_tables)
+        ]
+        if self.pages.ensure(sum(e for e in extra if e > 0)):
+            self.slab = grow_slab(self.slab, self.pages.num_pages * P)
+            _M_STATE_GROWS.inc()
+        fresh: list = []
+        new_tables = []
+        for t, e in zip(old_tables, extra):
+            if e > 0:
+                pages = self.pages.alloc(e)
+                fresh.extend(pages)
+                new_tables.append(list(t) + pages)
+            else:
+                new_tables.append(list(t))
+        dest = self._page_map(new_tables, width, a_pad,
+                              fill=self.pages.num_pages)
+        try:
+            self.slab = _dispatch(
+                paged_apply_ops, self.slab, jnp.asarray(gidx), changes,
+                jnp.asarray(dest), page_size=P,
+            )
+        except Exception:
+            # nothing committed: hand the delta pages back so a failed
+            # dispatch (degraded mode) leaks no slab capacity
+            self.pages.free(fresh)
+            raise
+        for d, t, n in zip(docs, new_tables, new_lens):
+            self.page_table[d] = t
+            self.lengths[d] = int(n)
+        self.version += 1
+        self._vis_memo.clear()
+        self._update_page_metrics()
+
+    def probe_apply(self, changes: ChangeOpsBatch, docs, counts=None):
+        """Runs the merge for `docs` on a throwaway basis (no scatter, no
+        donation, no state advance): the bisection probe for device-fault
+        isolation."""
+        docs = [int(d) for d in docs]
+        a_pad, m = changes.key.shape
+        lens = self.lengths[docs] if docs else np.zeros(0, np.int64)
+        width = self._width((int(lens.max()) if docs else 0) + m)
+        tables = [self.page_table[d] for d in docs]
+        gidx = self._page_map(tables, width, a_pad, fill=0)
+        out = paged_probe_ops(
+            self.slab, jnp.asarray(gidx), changes,
+            page_size=self.pages.page_size,
+        )
+        jax.block_until_ready(out)
+
+    def visible_state(self, actor_rank=None, docs=None):
+        """Device-resident visibility pytree for `docs` (None = every
+        document): per-row (key, op, visible, winner, value_total) arrays
+        of shape [len(docs), W], W = pow2 bucket of the largest requested
+        doc. Memoised per (state version, doc subset, actor-rank table)."""
         _fault_point("engine.visible_state")
+        docs_t = (
+            tuple(range(self.num_docs)) if docs is None
+            else tuple(int(d) for d in docs)
+        )
         rank_key = (
             None if actor_rank is None else np.asarray(actor_rank).tobytes()
         )
-        key = (self.version, rank_key)
-        if self._vis_memo is not None and self._vis_memo[0] == key:
-            return self._vis_memo[1]
-        out = batched_visible_state(self.state, actor_rank=actor_rank)
-        self._vis_memo = (key, out)
+        key = (docs_t, rank_key)
+        hit = self._vis_memo.get(key)
+        if hit is not None:
+            return hit
+        lens = (
+            self.lengths[list(docs_t)] if docs_t else np.zeros(0, np.int64)
+        )
+        width = self._width(int(lens.max()) if len(lens) else 1)
+        a_pad = self._pow2(len(docs_t))
+        tables = [self.page_table[d] for d in docs_t]
+        gidx = self._page_map(tables, width, a_pad, fill=0)
+        if actor_rank is None:
+            out = _dispatch(
+                paged_visible_plain, self.slab, jnp.asarray(gidx),
+                page_size=self.pages.page_size,
+            )
+        else:
+            out = _dispatch(
+                paged_visible_ranked, self.slab, jnp.asarray(gidx),
+                jnp.asarray(actor_rank), page_size=self.pages.page_size,
+            )
+        out = jax.tree_util.tree_map(lambda a: a[: len(docs_t)], out)
+        if len(self._vis_memo) > 16:
+            self._vis_memo.clear()
+        self._vis_memo[key] = out
         return out
 
-    def read_visibility_rows(self, flat_idx, actor_rank=None):
-        """Scoped device→host visibility readback: (visible, value_total)
-        numpy arrays for just the rows named by `flat_idx` (flattened
-        ``doc * capacity + row`` indices), via one padded device gather and
-        ONE jax.device_get — the transfer is O(rows requested), not O(whole
-        farm state)."""
-        n = int(flat_idx.shape[0])
-        if n == 0:
+    def read_visibility_rows(self, plan, actor_rank=None):
+        """Scoped device→host visibility readback: `plan` is a list of
+        ``(doc, row_idx array)`` pairs; returns (visible, value_total)
+        numpy arrays concatenated in plan order. Visibility is computed
+        for ONLY the planned docs' rows, then one padded device gather and
+        ONE jax.device_get move exactly the requested rows — O(rows
+        requested), not O(whole farm state)."""
+        plan = [
+            (int(d), np.asarray(idx, np.int64))
+            for d, idx in plan if len(idx)
+        ]
+        if not plan:
             return np.zeros(0, bool), np.zeros(0, np.int64)
-        _, _, visible, _, totals = self.visible_state(actor_rank)
+        docs_t = tuple(sorted({d for d, _ in plan}))
+        _k, _o, visible, _w, totals = self.visible_state(
+            actor_rank, docs=docs_t
+        )
+        w = visible.shape[1]
+        pos = {d: i for i, d in enumerate(docs_t)}
+        flat = np.concatenate([pos[d] * w + idx for d, idx in plan])
+        n = int(flat.shape[0])
         padded = 1 << max(0, n - 1).bit_length()
-        idx = np.zeros(padded, np.int32)
-        idx[:n] = flat_idx
+        idx = np.zeros(padded, np.int64)
+        idx[:n] = flat
         v, t = _dispatch(_gather_rows, visible, totals, jnp.asarray(idx))
         v, t = jax.device_get((v, t))
         return v[:n], t[:n]
+
+    def dense_view(self, docs=None):
+        """Host copies of the six op columns as dense [D, W] arrays (the
+        whole-state debug/parity readback — production paths stay paged)."""
+        docs_t = (
+            tuple(range(self.num_docs)) if docs is None
+            else tuple(int(d) for d in docs)
+        )
+        lens = self.lengths[list(docs_t)] if docs_t else np.zeros(0, np.int64)
+        width = self._width(int(lens.max()) if len(lens) else 1)
+        gidx = self._page_map(
+            [self.page_table[d] for d in docs_t], width,
+            self._pow2(len(docs_t)), fill=0,
+        )
+        out = paged_dense_view(
+            self.slab, jnp.asarray(gidx), page_size=self.pages.page_size
+        )
+        return jax.device_get(
+            jax.tree_util.tree_map(lambda a: a[: len(docs_t)], out)
+        )
+
+    def restore_doc(self, d: int, pages, length: int) -> None:
+        """Rolls doc `d`'s page allocation back to a snapshot, returning
+        pages acquired since to the free list. No device rows are
+        rewritten: rollback always precedes the commit that would have
+        used them (or that commit's dispatch failed and already freed its
+        delta pages)."""
+        keep = set(pages)
+        self.pages.free([p for p in self.page_table[d] if p not in keep])
+        self.page_table[d] = list(pages)
+        self.lengths[d] = int(length)
+        self._update_page_metrics()
+
+    def _update_page_metrics(self) -> None:
+        if not _METRICS.enabled:
+            return
+        allocated = self.pages.allocated
+        _M_PAGES_ALLOC.set(allocated)
+        _M_PAGES_FREE.set(self.pages.free_count)
+        if allocated:
+            _M_PAGES_OCC.set(
+                float(self.lengths.sum()) / (allocated * self.pages.page_size)
+            )
 
 
 def _grow_state(state: BatchedDocState, capacity: int) -> BatchedDocState:

@@ -61,6 +61,7 @@ from typing import NamedTuple
 import numpy as np
 
 from ..columnar import decode_change_cached, decode_change_meta_cached
+from .decode import warm_decode_cache
 from ..common import utf16_key
 from ..errors import (
     CausalityError,
@@ -261,9 +262,10 @@ class TpuDocFarm:
     quarantines that one delivery)."""
 
     def __init__(self, num_docs: int, capacity: int = 1024,
-                 quarantine_threshold: int | None = 3):
+                 quarantine_threshold: int | None = 3,
+                 page_size: int | None = None):
         self.num_docs = num_docs
-        self.engine = BatchedMapEngine(num_docs, capacity)
+        self.engine = BatchedMapEngine(num_docs, capacity, page_size=page_size)
         # interners are shared across the batch: actor ids, (objectId, key)
         # slots and scalar values are global tables, document state is not.
         # Caps guard the merge-key packing ranges (slot << 44 | ctr << 20 |
@@ -822,6 +824,14 @@ class TpuDocFarm:
                     _M_Q_SHED.inc()
 
         with prof.phase("decode"):
+            # batched first-touch decode: every distinct cache miss in the
+            # delivery parses in ONE vector pass (tpu/decode) — the per-doc
+            # loop below then hits the shared LRU. Buffers the batch pass
+            # cannot decode stay uncached and raise their canonical error
+            # inside the owning doc's fault domain.
+            warm_decode_cache(
+                [b for buffers in per_doc_buffers for b in buffers]
+            )
             per_doc_decoded = []
             for d, buffers in enumerate(per_doc_buffers):
                 decoded = []
@@ -952,32 +962,41 @@ class TpuDocFarm:
                 if c["hash"] in delivered
             ))
 
-        # one device merge for the whole batch
+        # one device merge for the ACTIVE docs only: the paged engine
+        # gathers just their rows from the slab, so idle documents cost
+        # neither HBM traffic nor kernel work
         width = max((len(r) for r in per_doc_rows), default=0)
         device_failed = False
         per_doc_arrays = [None] * self.num_docs
+        active = ()
         if width > 0:
             # dense row columns per doc, shared by pack, the bisect probes
             # and the host mirror merge
             for d, rows in enumerate(per_doc_rows):
                 if rows:
                     per_doc_arrays[d] = np.asarray(rows, np.int64)
+            active = tuple(
+                d for d in range(self.num_docs) if per_doc_rows[d]
+            )
             if _METRICS.enabled:
+                # pad waste is measured over the ACTIVE docs' cells: idle
+                # documents no longer ride the dispatch at all (the paged
+                # engine gathers only active rows), and the pow2 doc-count
+                # bucket is the bounded price of shape caching, not waste
                 rows = sum(len(r) for r in per_doc_rows)
-                cells = self.num_docs * width
+                cells = len(active) * width
                 _M_ROWS.inc(rows)
                 _M_PAD_ROWS.inc(cells - rows)
                 _M_PAD_RATIO.set(1.0 - rows / cells)
                 _M_OCCUPANCY.observe(rows / cells)
             with prof.phase("pack"):
-                batch = self._pack_rows(per_doc_arrays, width=width)
-            with prof.phase("device_dispatch"):
-                active = tuple(
-                    d for d in range(self.num_docs) if per_doc_rows[d]
+                batch, counts = self._pack_subset(
+                    per_doc_arrays, active, width=width
                 )
+            with prof.phase("device_dispatch"):
                 try:
                     _fault_point("farm.device_dispatch", docs=active)
-                    self.engine.apply_batch(batch)
+                    self.engine.apply_batch(batch, docs=active, counts=counts)
                 except Exception as exc:
                     if not doc_mode:
                         raise
@@ -1111,6 +1130,11 @@ class TpuDocFarm:
             "elem_index": dict(self.elem_index[d]),
             "elem_ids": list(self.elem_ids[d]),
             "elem_object": list(self.elem_object[d]),
+            # paged op storage: the doc's slab pages + live row count, so
+            # rollback returns any since-acquired pages to the allocator
+            # instead of leaking them
+            "pages": tuple(self.engine.page_table[d]),
+            "page_rows": int(self.engine.lengths[d]),
         }
 
     def _restore_doc(self, d: int, snap: dict) -> None:
@@ -1135,6 +1159,7 @@ class TpuDocFarm:
         self.elem_index[d] = snap["elem_index"]
         self.elem_ids[d] = snap["elem_ids"]
         self.elem_object[d] = snap["elem_object"]
+        self.engine.restore_doc(d, snap["pages"], snap["page_rows"])
         # a rolled-back delivery must never be served stale visibility:
         # conservatively mark every span of the doc for re-read (cheap —
         # rollback is the rare path)
@@ -1152,52 +1177,50 @@ class TpuDocFarm:
             "diffs": _empty_object_patch("_root", "map"),
         }
 
-    def _pack_rows(self, per_doc_arrays, width=None, only=None):
-        """Packs per-doc dense row column arrays ([n, 5] int64 of
-        (slot, op, action, value, pred); None for empty docs) into padded
-        device tensors by whole-column assignment. `only` restricts to a
-        subset of docs (others all-padding) for bisection probes."""
+    def _pack_subset(self, per_doc_arrays, docs, width=None):
+        """Packs the given docs' dense row column arrays ([n, 5] int64 of
+        (slot, op, action, value, pred); None for empty docs) into a
+        pow2-doc-padded ChangeOpsBatch [A_pad, width] by whole-column
+        assignment. Returns (batch, per-doc real row counts) — the paged
+        engine needs the counts to size page allocations host-side."""
+        docs = list(docs)
+        arrays = [per_doc_arrays[d] for d in docs]
         if width is None:
             width = max(
-                (a.shape[0] for a in per_doc_arrays if a is not None),
-                default=0,
+                (a.shape[0] for a in arrays if a is not None), default=0
             ) or 1
-        keys = np.full((self.num_docs, width), PAD_KEY, np.int32)
-        ops = np.zeros((self.num_docs, width), np.int64)
-        actions = np.zeros((self.num_docs, width), np.int32)
-        values = np.zeros((self.num_docs, width), np.int64)
-        preds = np.full((self.num_docs, width), -1, np.int64)
-        for d, arr in enumerate(per_doc_arrays):
-            if arr is None or (only is not None and d not in only):
+        a_pad = 1 << max(0, len(docs) - 1).bit_length()
+        keys = np.full((a_pad, width), PAD_KEY, np.int32)
+        ops = np.zeros((a_pad, width), np.int64)
+        actions = np.zeros((a_pad, width), np.int32)
+        values = np.zeros((a_pad, width), np.int64)
+        preds = np.full((a_pad, width), -1, np.int64)
+        counts = np.zeros(len(docs), np.int64)
+        for k, arr in enumerate(arrays):
+            if arr is None:
                 continue
             n = arr.shape[0]
-            keys[d, :n] = arr[:, 0]
-            ops[d, :n] = arr[:, 1]
-            actions[d, :n] = arr[:, 2]
-            values[d, :n] = arr[:, 3]
-            preds[d, :n] = arr[:, 4]
-        return changes_from_numpy(keys, ops, actions, values, preds)
+            counts[k] = n
+            keys[k, :n] = arr[:, 0]
+            ops[k, :n] = arr[:, 1]
+            actions[k, :n] = arr[:, 2]
+            values[k, :n] = arr[:, 3]
+            preds[k, :n] = arr[:, 4]
+        return changes_from_numpy(keys, ops, actions, values, preds), counts
 
     def _bisect_device_faults(self, per_doc_arrays, active):
         """Isolates the doc(s) whose rows crash the batched device program
-        by bisection: each probe dispatches a subset's rows against a
-        throwaway copy of the engine state (the real state is never
-        advanced here). Returns the poison doc set; `farm.bisect.rounds`
+        by bisection: each probe runs a subset's rows through the merge on
+        a throwaway basis (engine.probe_apply — no scatter, the slab is
+        never advanced). Returns the poison doc set; `farm.bisect.rounds`
         counts probes."""
-        import jax
-        import jax.numpy as jnp
-
-        from .engine import batched_apply_ops
 
         def probe_ok(group):
             _M_BISECT.inc()
             try:
                 _fault_point("farm.device_dispatch", docs=tuple(group))
-                state = jax.tree_util.tree_map(jnp.copy, self.engine.state)
-                out = batched_apply_ops(
-                    state, self._pack_rows(per_doc_arrays, only=set(group))
-                )
-                jax.block_until_ready(out)
+                batch, counts = self._pack_subset(per_doc_arrays, group)
+                self.engine.probe_apply(batch, group, counts)
                 return True
             except Exception:
                 return False
@@ -1335,13 +1358,9 @@ class TpuDocFarm:
             _M_RB_SKIPPED.inc(live - gathered)
         if not plan:
             return
-        capacity = self.engine.capacity
-        flat = np.concatenate(
-            [d * capacity + idx for d, idx in plan]
-        ).astype(np.int32)
         rank = self._actor_rank() if self.actors.table else None
         visible, totals = self.engine.read_visibility_rows(
-            flat, actor_rank=rank
+            plan, actor_rank=rank
         )
         offset = 0
         for d, idx in plan:
@@ -1364,17 +1383,20 @@ class TpuDocFarm:
     def _read_visibility(self):
         """Full-state readback — the reference path the incremental mirror
         is verified against (tests/test_parity_incremental.py): one batched
-        ``jax.device_get`` of the whole visibility pytree instead of five
-        separate per-array transfers. Production paths use the mirror; this
-        exists for whole-state debugging and the parity suite."""
+        ``jax.device_get`` of the whole visibility pytree plus a dense
+        gather of the action column from the paged slab. Production paths
+        use the mirror; this exists for whole-state debugging and the
+        parity suite."""
         import jax
 
         keys, ops, visible, _winners, totals = self.engine.visible_state(
             actor_rank=self._actor_rank() if self.actors.table else None
         )
-        return jax.device_get(
-            (keys, ops, visible, totals, self.engine.state.action)
+        keys, ops, visible, totals = jax.device_get(
+            (keys, ops, visible, totals)
         )
+        actions = self.engine.dense_view()[2]
+        return keys, ops, visible, totals, actions
 
     def _slot_span(self, d, slot):
         mkey = self._vis_mkey[d]

@@ -1,0 +1,229 @@
+"""Ragged paged op storage for the merge farm.
+
+Modeled on Ragged Paged Attention (PAPERS.md: arxiv 2604.15464): the dense
+engine state used to be one ``[docs, capacity]`` tensor per column with
+``capacity = pow2(largest doc)`` — a farm of wildly different document
+sizes pays largest-doc HBM for EVERY doc (the ``farm.pad_waste`` metric
+existed to measure exactly that), and every capacity doubling recompiles
+every program over the whole farm. Here op rows live in fixed-size pages
+allocated from one shared slab; each document owns a page list and a row
+count, and kernels address the slab through host-built row maps derived
+from ``(page_table, lengths)``:
+
+    row_map[a, r] = page_table[doc_a][r // P] * P + r % P    (r < len_a)
+                  = 0                                        (pad row)
+
+Page 0 is reserved as the immutable PAD page — its rows hold PAD values
+forever, so gathers of dead rows produce pad rows without branching, and
+scatters never target it (``dest == slab_rows`` drops pad writes instead).
+
+The merge program gathers the ACTIVE documents' rows into a dense
+``[A, W]`` working view (A = pow2-bucketed active-doc count, W = pow2
+bucket of the largest active doc + incoming rows), runs the unchanged
+merge kernel from engine.py, and scatters the merged rows back through
+the NEW page map inside the same XLA program. A delivery touching 3
+documents dispatches 3 documents' rows — not the farm — and a farm of
+mixed doc sizes packs the slab at page granularity (the
+``farm.pages.occupancy`` gauge replaces pad-waste as the HBM figure of
+merit).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import PAD_KEY, _merge_one_doc, _visible_state_one_doc, remap_opid_actors
+
+
+class SlabState(NamedTuple):
+    """One shared op slab: flat ``[num_pages * page_size]`` columns."""
+
+    key: jax.Array          # int32 interned key id (PAD_KEY when dead)
+    op: jax.Array           # int64 packed opId
+    action: jax.Array       # int32
+    value: jax.Array        # int64
+    pred: jax.Array         # int64 (-1 none)
+    overwritten: jax.Array  # bool
+
+
+def make_empty_slab(rows: int) -> SlabState:
+    return SlabState(
+        key=jnp.full((rows,), PAD_KEY, jnp.int32),
+        op=jnp.zeros((rows,), jnp.int64),
+        action=jnp.zeros((rows,), jnp.int32),
+        value=jnp.zeros((rows,), jnp.int64),
+        pred=jnp.full((rows,), -1, jnp.int64),
+        overwritten=jnp.zeros((rows,), jnp.bool_),
+    )
+
+
+def grow_slab(slab: SlabState, rows: int) -> SlabState:
+    """Extends the slab to `rows` total rows (new rows are PAD)."""
+    old = slab.key.shape[0]
+    pad = rows - old
+    if pad <= 0:
+        return slab
+
+    def grow(arr, fill):
+        return jnp.concatenate([arr, jnp.full((pad,), fill, arr.dtype)])
+
+    return SlabState(
+        key=grow(slab.key, PAD_KEY),
+        op=grow(slab.op, 0),
+        action=grow(slab.action, 0),
+        value=grow(slab.value, 0),
+        pred=grow(slab.pred, -1),
+        overwritten=grow(slab.overwritten, False),
+    )
+
+
+class PageAllocator:
+    """Host-side free list of fixed-size pages. Page 0 is the reserved PAD
+    page and is never handed out. Doubling `num_pages` signals the caller
+    to grow the device slab (ensure() returns True when that happened)."""
+
+    __slots__ = ("page_size", "num_pages", "_free")
+
+    def __init__(self, page_size: int = 64, initial_pages: int = 64):
+        assert page_size > 0 and (page_size & (page_size - 1)) == 0, (
+            "page_size must be a power of two (working widths are pow2-"
+            "bucketed and page-aligned)"
+        )
+        self.page_size = page_size
+        self.num_pages = max(2, initial_pages)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated(self) -> int:
+        """Pages currently owned by documents (PAD page excluded)."""
+        return self.num_pages - 1 - len(self._free)
+
+    def pages_for(self, rows: int) -> int:
+        return -(-rows // self.page_size)
+
+    def ensure(self, n: int) -> bool:
+        """Guarantees `n` free pages, growing the slab in ONE pow2 jump
+        (at least a doubling) when short — every distinct slab size is a
+        compiled-program shape, so growth events must stay logarithmic.
+        Returns True when `num_pages` changed (caller grows the slab)."""
+        if len(self._free) >= n:
+            return False
+        needed_total = self.num_pages + n - len(self._free)
+        old = self.num_pages
+        self.num_pages = max(
+            1 << (needed_total - 1).bit_length(), old * 2
+        )
+        self._free.extend(range(self.num_pages - 1, old - 1, -1))
+        return True
+
+    def alloc(self, n: int) -> list:
+        assert len(self._free) >= n, "alloc without ensure"
+        taken = self._free[len(self._free) - n:]
+        del self._free[len(self._free) - n:]
+        return taken[::-1]
+
+    def free(self, pages) -> None:
+        self._free.extend(pages)
+
+
+# ---------------------------------------------------------------------- #
+# device programs: gather -> kernel -> scatter, one XLA program each.
+#
+# Gathers and scatters move whole PAGES, not rows: the index tensors are
+# [A, W/P] page ids (64x fewer indices than row maps) and every move is a
+# contiguous page_size-row block — the difference between vectorised block
+# copies and scalarised element gathers. Correctness rests on the
+# page-tail invariant: rows of a page beyond its document's length always
+# hold PAD values. Fresh pages start PAD (make_empty_slab/grow_slab), and
+# every scatter writes full pages whose tail rows carry the merge kernel's
+# PAD output, so the invariant is inductive; gathering a doc's pages
+# therefore yields exactly the dense [len | PAD...] view the kernels
+# expect, with no per-row masking.
+
+def _gather_pages(slab: SlabState, page_idx, page_size: int):
+    a = page_idx.shape[0]
+
+    def g(col):
+        return col.reshape(-1, page_size)[page_idx].reshape(a, -1)
+
+    return tuple(g(col) for col in slab)
+
+
+@partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0,))
+def paged_apply_ops(slab: SlabState, gather_pages, changes, dest_pages, *,
+                    page_size: int) -> SlabState:
+    """applyChanges over the active documents: gather their pages from the
+    slab, merge the change batch with the unchanged per-doc kernel, and
+    scatter every merged page to its new slot. `dest_pages` holds
+    ``num_pages`` (out of range -> dropped) for pad slots, so dead pages
+    never write and the PAD page is never a target."""
+    a = gather_pages.shape[0]
+    s_key, s_op, s_action, s_value, s_pred, s_over = _gather_pages(
+        slab, gather_pages, page_size
+    )
+    num = jnp.zeros((a,), jnp.int32)  # host tracks lengths
+    key, op, action, value, pred, over, _num = jax.vmap(_merge_one_doc)(
+        s_key, s_op, s_action, s_value, s_pred, s_over, num,
+        changes.key, changes.op, changes.action, changes.value, changes.pred,
+    )
+
+    def scatter(col, vals):
+        paged = col.reshape(-1, page_size)
+        vals = vals.reshape(a, -1, page_size)
+        return paged.at[dest_pages].set(vals, mode="drop").reshape(-1)
+
+    return SlabState(
+        key=scatter(slab.key, key),
+        op=scatter(slab.op, op),
+        action=scatter(slab.action, action),
+        value=scatter(slab.value, value),
+        pred=scatter(slab.pred, pred),
+        overwritten=scatter(slab.overwritten, over),
+    )
+
+
+@partial(jax.jit, static_argnames=("page_size",))
+def paged_probe_ops(slab: SlabState, gather_pages, changes, *, page_size: int):
+    """The merge WITHOUT the scatter (and without donation): bisection
+    probes run the suspect subset against the live slab on a throwaway
+    basis — the slab is never advanced."""
+    s_key, s_op, s_action, s_value, s_pred, s_over = _gather_pages(
+        slab, gather_pages, page_size
+    )
+    num = jnp.zeros((gather_pages.shape[0],), jnp.int32)
+    return jax.vmap(_merge_one_doc)(
+        s_key, s_op, s_action, s_value, s_pred, s_over, num,
+        changes.key, changes.op, changes.action, changes.value, changes.pred,
+    )
+
+
+@partial(jax.jit, static_argnames=("page_size",))
+def paged_visible_plain(slab: SlabState, gather_pages, *, page_size: int):
+    key, op, action, value, pred, over = _gather_pages(
+        slab, gather_pages, page_size
+    )
+    return jax.vmap(_visible_state_one_doc)(key, op, action, value, pred, over, op)
+
+
+@partial(jax.jit, static_argnames=("page_size",))
+def paged_visible_ranked(slab: SlabState, gather_pages, actor_rank, *,
+                         page_size: int):
+    key, op, action, value, pred, over = _gather_pages(
+        slab, gather_pages, page_size
+    )
+    cmp = remap_opid_actors(op, actor_rank)
+    return jax.vmap(_visible_state_one_doc)(key, op, action, value, pred, over, cmp)
+
+
+@partial(jax.jit, static_argnames=("page_size",))
+def paged_dense_view(slab: SlabState, gather_pages, *, page_size: int):
+    """Dense [D, W] gather of all six columns (parity/debug readback)."""
+    return _gather_pages(slab, gather_pages, page_size)

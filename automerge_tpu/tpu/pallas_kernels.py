@@ -183,6 +183,75 @@ def _bloom_build_kernel(xyz_ref, modulo_ref, count_ref, out_ref):
         out_ref[0, :] = out_ref[0, :] | words
 
 
+_SEG_TILE = 128
+_BYTE_TILE = 512
+
+
+def _leb_segsum_kernel(planes_ref, seg_ref, out_ref):
+    """One (varint-tile, byte-tile) cell of the LEB128 segmented sum.
+
+    Blocks: planes [B_T, P] f32 (14-bit payload planes per byte), seg
+    [B_T, 1] int32 (varint id per byte, -1 for padding), out [V_T, P] f32.
+    Each byte belongs to exactly one varint, so accumulating partial
+    one-hot matmuls over byte tiles reconstructs the exact per-varint
+    plane sums (every product is an integer < 2^17, exact in f32)."""
+    v_idx = pl.program_id(1)
+    b_idx = pl.program_id(2)
+    v_t = out_ref.shape[0]
+    seg = seg_ref[:, 0]  # [B_T]
+    local = seg - v_idx * v_t
+    onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (v_t, seg.shape[0]), 0) == local[None, :]
+    ).astype(jnp.float32)  # [V_T, B_T]
+    partial_sums = jnp.dot(
+        onehot, planes_ref[...], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(b_idx == 0)
+    def _init():
+        out_ref[...] = partial_sums
+
+    @pl.when(b_idx > 0)
+    def _accumulate():
+        out_ref[...] = out_ref[...] + partial_sums
+
+
+@partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def leb128_segment_sum(planes, seg_ids, num_segments: int, *, interpret=False):
+    """Per-varint payload-plane sums for the vectorized LEB128 decode
+    (tpu/decode.leb128_scan_device): ``out[v, p] = sum(planes[i, p] for i
+    with seg_ids[i] == v)``.
+
+    planes: [N, P] f32, seg_ids: [N] int32 in [0, num_segments). XLA
+    lowers this reduction to serialised scatters on TPU; here it rides the
+    MXU as a tiled one-hot contraction, the same pattern as the Bloom
+    word gather above."""
+    n, p = planes.shape
+    b_t = min(_pad_to(n, 8), _BYTE_TILE)
+    v_t = min(_pad_to(num_segments, 8), _SEG_TILE)
+    n_pad = _pad_to(n, b_t)
+    v_pad = _pad_to(num_segments, v_t)
+    planes = jnp.pad(planes, ((0, n_pad - n), (0, 0)))
+    seg_ids = jnp.pad(
+        seg_ids.astype(jnp.int32), (0, n_pad - n), constant_values=-1
+    )
+
+    out = pl.pallas_call(
+        _leb_segsum_kernel,
+        grid=(1, v_pad // v_t, n_pad // b_t),
+        in_specs=[
+            pl.BlockSpec((b_t, p), lambda g, v, b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((b_t, 1), lambda g, v, b: (b, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (v_t, p), lambda g, v, b: (v, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((v_pad, p), jnp.float32),
+        interpret=interpret,
+    )(planes, seg_ids.reshape(n_pad, 1))
+    return out[:num_segments]
+
+
 @partial(jax.jit, static_argnames=("num_words", "interpret"))
 def bloom_build(xyz, counts, num_words: int, *, interpret=False):
     """Pallas analogue of sync_batch.build_filters.

@@ -165,12 +165,143 @@ def bench_end_to_end(num_docs, rounds, ops_per_round, seed=0):
             "rows_transcoded": _value("farm.rows.transcoded"),
             "rows_padding": _value("farm.rows.padding"),
             "pad_waste_ratio": round(_value("farm.pad_waste_ratio"), 4),
+            "pages_allocated": _value("farm.pages.allocated"),
+            "pages_occupancy": round(_value("farm.pages.occupancy"), 4),
+            "vector_chunks": _value("codecs.vector.chunks"),
+            "vector_bytes": _value("codecs.vector.bytes"),
             "changes_applied": _value("farm.changes.applied"),
             "gate_deferrals": _value("farm.gate.deferrals"),
             "sync_bytes_sent": _value("sync.bytes.sent"),
             "sync_bytes_received": _value("sync.bytes.received"),
         },
     }
+
+
+def bench_decode(streams=25, rounds=8, ops_per_round=64):
+    """`bench.py --decode`: the columnar decode microbench — cold vs warm
+    MB/s through the scalar oracle, the vectorized column passes
+    (tpu/decode.py) and the native C++ codecs (when built). Cold decode
+    parses distinct buffers (the farm's first-touch shape); warm decode
+    replays them through the shared LRU (the gossip/fan-out shape)."""
+    from unittest import mock
+
+    import automerge_tpu.columnar as columnar
+    from automerge_tpu import native
+    from automerge_tpu.tpu import decode as vdec
+
+    buffers = []
+    for seed in range(streams):
+        buffers.extend(_make_change_stream(rounds, ops_per_round, seed))
+    mb = sum(len(b) for b in buffers) / 1e6
+
+    def best(fn, n=3):
+        times = []
+        for _ in range(n):
+            columnar.clear_decode_caches()
+            t = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t)
+        return min(times)
+
+    def run_scalar():
+        with mock.patch.object(native, "available", lambda: False):
+            with mock.patch.object(columnar, "_VECTOR_DECODER", None):
+                for b in buffers:
+                    columnar.decode_change(b)
+
+    def run_vector():
+        with mock.patch.object(native, "available", lambda: False):
+            vdec.decode_changes_vector(buffers)
+
+    def run_native():
+        for b in buffers:
+            columnar.decode_change(b)
+
+    def run_warm():
+        for b in buffers:
+            columnar.decode_change_cached(b)
+
+    out = {
+        "buffers": len(buffers),
+        "mb": round(mb, 3),
+        "scalar_cold_s": round(best(run_scalar), 4),
+        "vector_cold_s": round(best(run_vector), 4),
+    }
+    if native.available():
+        out["native_cold_s"] = round(best(run_native), 4)
+    columnar.clear_decode_caches()
+    for b in buffers:
+        columnar.decode_change_cached(b)  # populate once
+    t = time.perf_counter()
+    run_warm()
+    out["warm_s"] = round(time.perf_counter() - t, 4)
+    out["scalar_cold_mb_s"] = round(mb / out["scalar_cold_s"], 2)
+    out["vector_cold_mb_s"] = round(mb / out["vector_cold_s"], 2)
+    out["warm_mb_s"] = round(mb / max(out["warm_s"], 1e-9), 2)
+    out["vector_vs_scalar"] = round(
+        out["scalar_cold_s"] / out["vector_cold_s"], 2
+    )
+    return out
+
+
+def bench_pages(num_docs=64, page_size=None):
+    """`bench.py --pages`: slab packing on a mixed-size farm — documents
+    spanning two orders of magnitude of op counts, reported as page
+    occupancy vs what the dense pow2-per-doc layout would have allocated."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    # 64..548 ops, deliberately NOT page-aligned (the +d%37 jitter)
+    sizes = [(d % 8 + 1) * 64 + d % 37 for d in range(num_docs)]
+    streams = []
+    for d, s in enumerate(sizes):
+        schedule = [64] * (s // 64) + ([s % 64] if s % 64 else [])
+        streams.append(_make_change_stream(0, 0, seed=d, schedule=schedule))
+    metrics = get_metrics()
+    metrics.reset()
+    with enabled_metrics():
+        farm = TpuDocFarm(num_docs, capacity=64, page_size=page_size)
+        rounds = max(len(s) for s in streams)
+        for r in range(rounds):
+            farm.apply_changes([
+                [s[r]] if r < len(s) else [] for s in streams
+            ])
+    snap = metrics.as_dict()
+    lens = farm.engine.lengths
+    page = farm.engine.pages.page_size
+    allocated = farm.engine.pages.allocated
+    dense_cells = int(num_docs * (1 << int(lens.max() - 1).bit_length()))
+    return {
+        "docs": num_docs,
+        "page_size": page,
+        "rows": int(lens.sum()),
+        "pages_allocated": allocated,
+        "occupancy": round(
+            snap.get("farm.pages.occupancy", {}).get("value", 0.0), 4
+        ),
+        "paged_cells": allocated * page,
+        "dense_pow2_cells": dense_cells,
+        "hbm_saving": round(1 - allocated * page / dense_cells, 4),
+    }
+
+
+def _decode_main():
+    """One JSON line: decode microbench + mixed-size page packing. The
+    gate asserts the structural wins — vectorized cold decode beats the
+    scalar oracle and the mixed farm packs pages at >= 80%."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    decode = bench_decode()
+    pages = bench_pages()
+    ok = decode["vector_vs_scalar"] >= 1.5 and pages["occupancy"] >= 0.8
+    print(json.dumps({
+        "metric": "cold columnar decode throughput (vectorized MB/s)",
+        "value": decode["vector_cold_mb_s"],
+        "unit": "MB/s",
+        "ok": ok,
+        "decode": decode,
+        "pages": pages,
+    }))
+    sys.exit(0 if ok else 1)
 
 
 def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
@@ -685,6 +816,8 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--decode" in sys.argv or "--pages" in sys.argv:
+        _decode_main()
     elif "--serve" in sys.argv:
         _serve_main(quick="--quick" in sys.argv)
     elif "--quick" in sys.argv:

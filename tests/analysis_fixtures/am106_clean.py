@@ -1,0 +1,17 @@
+# amlint: hot-path — fixture: record-level walks stay clean (AM106)
+
+
+def expand_records(counts, values):
+    """O(records) Python, O(rows) array work: the walk steps per RECORD
+    (two varints at a time), never per byte."""
+    out = []
+    i = 0
+    while i < len(counts):
+        out.append((counts[i], values[i]))
+        i += 2  # record stride, not a byte cursor
+    return out
+
+
+def boundary_mask(flags):
+    """The vectorized shape: boundaries come from a mask, not a loop."""
+    return [j for j, cont in enumerate(flags) if not cont]

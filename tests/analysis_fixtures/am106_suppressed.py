@@ -1,0 +1,13 @@
+# amlint: hot-path — fixture: justified suppressions silence AM106
+
+
+def oracle_varint(buf, offset):
+    """A deliberate scalar oracle inside a decode module."""
+    value = 0
+    shift = 0
+    # amlint: disable=AM106 — scalar parity oracle for the vector pass
+    while buf[offset] & 0x80:
+        value |= (buf[offset] & 0x7F) << shift
+        shift += 7
+        offset += 1
+    return value | (buf[offset] << shift), offset + 1

@@ -1,0 +1,22 @@
+# amlint: hot-path — fixture: per-byte decode loops (AM106)
+
+
+def read_varint(buf, offset):
+    """The scalar LEB128 shape: one Python iteration per byte."""
+    value = 0
+    shift = 0
+    while buf[offset] & 0x80:
+        value |= (buf[offset] & 0x7F) << shift
+        shift += 7
+        offset += 1
+    return value | (buf[offset] << shift), offset + 1
+
+
+def count_runs(data):
+    runs = 0
+    i = 0
+    while i < len(data):
+        if not data[i] & 0x80:
+            runs += 1
+        i += 1
+    return runs

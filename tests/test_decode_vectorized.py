@@ -1,0 +1,398 @@
+"""Byte-corpus parity suite for the vectorized columnar decode
+(automerge_tpu/tpu/decode.py).
+
+The vectorized passes must be BIT-FOR-BIT identical to the scalar oracle
+(the per-op decoder chain in codecs.py/columnar.py) over:
+
+- the bench change stream and fuzzed changes covering every op shape the
+  wire format encodes (nested objects, counters, inc/del, multi-pred,
+  list inserts, every value datatype, multi-actor tables);
+- corrupt/truncated inputs: the same ``DecodeError``/``ChecksumError``
+  taxonomy with caches left untouched;
+- save/load round-trips through the document chunk format;
+- the column codecs themselves (RLE/Delta/Boolean run grammars).
+"""
+import random
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import automerge_tpu.columnar as columnar
+from automerge_tpu import backend as Backend
+from automerge_tpu import native
+from automerge_tpu.codecs import (
+    BooleanDecoder,
+    BooleanEncoder,
+    DecodeCache,
+    DeltaDecoder,
+    DeltaEncoder,
+    Encoder,
+    RLEDecoder,
+    RLEEncoder,
+)
+from automerge_tpu.errors import ChecksumError, DecodeError
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu import decode as vdec
+
+
+def oracle_decode(buffer):
+    """decode_change through the per-op scalar decoder chain only."""
+    with mock.patch.object(native, "available", lambda: False):
+        with mock.patch.object(columnar, "_VECTOR_DECODER", None):
+            return columnar.decode_change(buffer)
+
+
+def vector_decode(buffer):
+    """decode_change through the vectorized backend only (native off)."""
+    with mock.patch.object(native, "available", lambda: False):
+        return columnar.decode_change(buffer)
+
+
+def _fuzz_change(rng, actor, seq, start_op, deps, known_ops, known_elems):
+    """One structurally valid change exercising the full op vocabulary."""
+    ops = []
+    ctr = start_op
+    n = rng.randrange(1, 9)
+    for _ in range(n):
+        kind = rng.random()
+        key = f"k{rng.randrange(6)}é{rng.randrange(3)}"
+        pred = []
+        if known_ops and rng.random() < 0.5:
+            pred = sorted(
+                rng.sample(known_ops, min(len(known_ops), rng.randrange(1, 3))),
+                key=lambda p: (int(p.split("@")[0]), p.split("@")[1]),
+            )
+        if kind < 0.55:
+            value = rng.choice([
+                rng.randrange(-2**53 + 1, 2**53 - 1),
+                rng.random() * 1e9,
+                "v" * rng.randrange(0, 5) + "☃",
+                b"\x00\xff" * rng.randrange(0, 3),
+                True, False, None,
+            ])
+            op = {"action": "set", "obj": "_root", "key": key,
+                  "value": value, "pred": pred}
+            if isinstance(value, int) and not isinstance(value, bool):
+                op["datatype"] = rng.choice(
+                    ["counter", "timestamp", "int", None]
+                    + (["uint"] if value >= 0 else [])
+                )
+                if op["datatype"] is None:
+                    del op["datatype"]
+            elif isinstance(value, float):
+                op["datatype"] = "float64"
+        elif kind < 0.7:
+            op = {"action": rng.choice(["makeMap", "makeTable"]),
+                  "obj": "_root", "key": key, "pred": pred}
+        elif kind < 0.8 and known_ops:
+            op = {"action": "inc", "obj": "_root", "key": key,
+                  "value": rng.randrange(-5, 10), "pred": pred or [known_ops[0]]}
+        elif kind < 0.9 and known_ops:
+            op = {"action": "del", "obj": "_root", "key": key,
+                  "pred": pred or [known_ops[0]]}
+        else:
+            # list insert: element keyed by elemId, optionally chained
+            ref = rng.choice(known_elems) if known_elems and rng.random() < 0.6 else "_head"
+            op = {"action": "set", "obj": "_root", "elemId": ref,
+                  "insert": True, "value": rng.randrange(100), "pred": []}
+            known_elems.append(f"{ctr}@{actor}")
+        ops.append(op)
+        known_ops.append(f"{ctr}@{actor}")
+        ctr += 1
+    return {
+        "actor": actor, "seq": seq, "startOp": start_op, "time": rng.randrange(2**31),
+        "message": rng.choice(["", "méssage", "x" * 40]),
+        "deps": sorted(deps), "ops": ops,
+    }, ctr
+
+
+class TestChunkParity:
+    def test_bench_stream(self):
+        from bench import _make_change_stream
+
+        for buf in _make_change_stream(6, 48, 3):
+            assert vector_decode(buf) == oracle_decode(buf)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzzed_changes(self, seed):
+        rng = random.Random(seed)
+        known_ops, known_elems = [], []
+        start_op, deps = 1, []
+        bufs = []
+        for i, actor in enumerate(["aaaaaaaa", "bbbbbbbb", "cdcdcdcd"] * 3):
+            change, start_op = _fuzz_change(
+                rng, actor, i // 3 + 1, start_op, deps, known_ops, known_elems
+            )
+            buf = columnar.encode_change(change)
+            deps = [columnar.decode_change_columns(buf)["hash"]]
+            bufs.append(buf)
+        oracle = [oracle_decode(b) for b in bufs]
+        for b, expected in zip(bufs, oracle):
+            assert vector_decode(b) == expected
+        # and through the batched entry point, which shares one scan
+        with mock.patch.object(native, "available", lambda: False):
+            assert vdec.decode_changes_vector(bufs) == oracle
+
+    def test_deflated_change(self):
+        big = faults.make_change(
+            "aaaaaaaa", 1, 1,
+            [], [faults.set_op(f"key{i}", i) for i in range(200)],
+        )
+        assert len(big) > 0 and vector_decode(big) == oracle_decode(big)
+
+
+class TestCorruptInputs:
+    @pytest.mark.parametrize("name,corrupter,kind", faults.BYTE_CORPUS,
+                             ids=[c[0] for c in faults.BYTE_CORPUS])
+    def test_same_error_taxonomy(self, name, corrupter, kind):
+        base = faults.make_change(
+            "aaaaaaaa", 1, 1, [], [faults.set_op("k", 7)]
+        )
+        bad = bytes(corrupter(base))
+        with pytest.raises(Exception) as oracle_exc:
+            oracle_decode(bad)
+        with pytest.raises(Exception) as vector_exc:
+            vector_decode(bad)
+        assert type(vector_exc.value) is type(oracle_exc.value)
+        assert str(vector_exc.value) == str(oracle_exc.value)
+        assert isinstance(vector_exc.value, (DecodeError, ChecksumError))
+
+    def test_corrupt_buffers_left_uncached(self):
+        columnar.clear_decode_caches()
+        base = faults.make_change("aaaaaaaa", 1, 1, [], [faults.set_op("k", 7)])
+        bad = faults.truncated(base)
+        before = len(columnar._DECODED_CHANGE_CACHE)
+        assert vdec.warm_decode_cache([base, bad]) == 1
+        assert len(columnar._DECODED_CHANGE_CACHE) == before + 1
+        # the bad buffer still raises its canonical error on the scalar path
+        with pytest.raises(DecodeError):
+            columnar.decode_change_cached(bad)
+        columnar.clear_decode_caches()
+
+    def test_batch_with_one_bad_buffer_raises_like_sequential(self):
+        good = faults.make_change("aaaaaaaa", 1, 1, [], [faults.set_op("k", 1)])
+        bad = faults.garbage(32)
+        with pytest.raises(DecodeError):
+            vdec.decode_changes_vector([good, bad])
+
+
+class TestSaveLoadRoundTrip:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_document_chunks(self, seed):
+        from bench import _make_change_stream
+
+        b = Backend.init()
+        for buf in _make_change_stream(5, 24, 200 + seed):
+            b, _ = Backend.apply_changes(b, [buf])
+        # a second actor layering counters, dels and nested objects on top
+        ops = [
+            {"action": "set", "obj": "_root", "key": "c",
+             "datatype": "counter", "value": 5, "pred": []},
+            {"action": "makeMap", "obj": "_root", "key": "child", "pred": []},
+        ]
+        c1 = faults.make_change("bbbbbbbb", 1, 1, Backend.get_heads(b), ops)
+        b, _ = Backend.apply_changes(b, [c1])
+        h1 = columnar.decode_change_columns(c1)["hash"]
+        ops2 = [
+            {"action": "inc", "obj": "_root", "key": "c", "value": 3,
+             "pred": ["1@bbbbbbbb"]},
+            {"action": "set", "obj": "2@bbbbbbbb", "key": "nested",
+             "value": "x", "pred": []},
+        ]
+        c2 = faults.make_change("bbbbbbbb", 2, 3, [h1], ops2)
+        b, _ = Backend.apply_changes(b, [c2])
+        saved = Backend.save(b)
+        with mock.patch.object(native, "available", lambda: False):
+            with mock.patch.object(columnar, "_VECTOR_DECODER", None):
+                oracle_patch = Backend.get_patch(Backend.load(saved))
+            vector_patch = Backend.get_patch(Backend.load(saved))
+        assert vector_patch == oracle_patch
+        assert Backend.save(Backend.load(saved)) == saved
+
+
+def _scalar_rle(type_, buf):
+    dec = RLEDecoder(type_, buf)
+    out = []
+    while not dec.done:
+        out.append(dec.read_value())
+    return out
+
+
+class TestColumnCodecs:
+    """Column-level parity: vector expansion vs the scalar decoders over
+    generated run/literal/null mixes."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_rle_uint(self, seed):
+        rng = random.Random(seed)
+        values = []
+        for _ in range(rng.randrange(1, 30)):
+            v = rng.choice([None, rng.randrange(0, 2**50)])
+            values.extend([v] * rng.randrange(1, 6))
+        enc = RLEEncoder("uint")
+        for v in values:
+            enc.append_value(v)
+        buf = enc.buffer
+        scan = vdec._Scan([buf])
+        lo, hi = scan.seg(0)
+        got = vdec._rle_expand(scan, lo, hi, signed=False)
+        expected = _scalar_rle("uint", buf)
+        got_l = [None if x == native.NULL_SENTINEL else x for x in got.tolist()]
+        assert got_l == expected
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_delta(self, seed):
+        rng = random.Random(seed)
+        values = []
+        cur = 0
+        for _ in range(rng.randrange(1, 40)):
+            if rng.random() < 0.2:
+                values.append(None)
+            else:
+                cur += rng.randrange(-50, 50)
+                values.append(cur)
+        enc = DeltaEncoder()
+        for v in values:
+            enc.append_value(v)
+        buf = enc.buffer
+        dec = DeltaDecoder(buf)
+        expected = []
+        while not dec.done:
+            expected.append(dec.read_value())
+        scan = vdec._Scan([buf])
+        lo, hi = scan.seg(0)
+        got = vdec._delta_expand(scan, lo, hi)
+        got_l = [None if x == native.NULL_SENTINEL else x for x in got.tolist()]
+        assert got_l == expected
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_boolean(self, seed):
+        rng = random.Random(seed)
+        values = []
+        for _ in range(rng.randrange(1, 20)):
+            values.extend([rng.random() < 0.5] * rng.randrange(1, 7))
+        enc = BooleanEncoder()
+        for v in values:
+            enc.append_value(v)
+        buf = enc.buffer
+        dec = BooleanDecoder(buf)
+        expected = []
+        while not dec.done:
+            expected.append(dec.read_value())
+        scan = vdec._Scan([buf])
+        lo, hi = scan.seg(0)
+        assert vdec._bool_expand(scan, lo, hi).tolist() == expected
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_strrle(self, seed):
+        rng = random.Random(seed)
+        words = ["", "a", "longer-key", "élément", "x" * 200]
+        values = []
+        for _ in range(rng.randrange(1, 25)):
+            v = rng.choice([None] + words)
+            values.extend([v] * rng.randrange(1, 5))
+        enc = RLEEncoder("utf8")
+        for v in values:
+            enc.append_value(v)
+        buf = enc.buffer
+        expected = _scalar_rle("utf8", buf)
+        blob, offs = vdec._strrle_expand(buf)
+        got = [
+            None if s < 0 else blob[s:e].decode("utf-8", "surrogatepass")
+            for s, e in offs.tolist()
+        ]
+        assert got == expected
+
+    def test_bad_run_grammar_defers_to_oracle(self):
+        """Streams the scalar decoder rejects make the vector pass raise
+        _Fallback (the chunk then re-decodes through the oracle, which
+        owns the canonical error)."""
+        def rle_bytes(records):
+            enc = Encoder()
+            for rec in records:
+                for kind, v in rec:
+                    if kind == "i":
+                        enc.append_int53(v)
+                    else:
+                        enc.append_uint53(v)
+            return enc.buffer
+
+        bad_streams = [
+            rle_bytes([[("i", 1), ("u", 5)]]),                 # count of 1
+            rle_bytes([[("i", 0), ("u", 0)]]),                 # zero null run
+            rle_bytes([[("i", 0), ("u", 2)], [("i", 0), ("u", 2)]]),  # 2 null runs
+            rle_bytes([[("i", 3), ("u", 7)], [("i", 2), ("u", 7)]]),  # same rep
+            rle_bytes([[("i", -1), ("u", 4)], [("i", -1), ("u", 5)]]),  # 2 literals
+            rle_bytes([[("i", -2), ("u", 4), ("u", 4)]]),      # rep in literal
+        ]
+        for buf in bad_streams:
+            with pytest.raises(DecodeError):
+                _scalar_rle("uint", bytes(buf))
+            scan = vdec._Scan([bytes(buf)])
+            with pytest.raises(vdec._Fallback):
+                vdec._rle_expand(scan, *scan.seg(0), signed=False)
+
+
+class TestLeb128Scan:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_roundtrip(self, seed):
+        rng = random.Random(seed)
+        uvals = [rng.randrange(0, 2**53) for _ in range(200)]
+        ivals = [rng.randrange(-2**52, 2**52) for _ in range(200)]
+        ue, ie = Encoder(), Encoder()
+        for v in uvals:
+            ue.append_uint53(v)
+        for v in ivals:
+            ie.append_int53(v)
+        su = vdec.leb128_scan(np.frombuffer(ue.buffer, np.uint8))
+        assert su[2].tolist() == uvals
+        si = vdec.leb128_scan(np.frombuffer(ie.buffer, np.uint8))
+        assert si[3].tolist() == ivals
+
+    def test_truncated_stream_falls_back(self):
+        enc = Encoder()
+        enc.append_uint53(2**40)
+        data = np.frombuffer(enc.buffer[:-1], np.uint8)
+        with pytest.raises(vdec._Fallback):
+            vdec.leb128_scan(data)
+
+    def test_wide_varint_falls_back(self):
+        data = np.frombuffer(bytes([0x80] * 9 + [0x01]), np.uint8)
+        with pytest.raises(vdec._Fallback):
+            vdec.leb128_scan(data)
+
+    def test_device_scan_matches_host(self):
+        rng = random.Random(9)
+        enc = Encoder()
+        vals = [rng.randrange(0, 2**50) for _ in range(300)]
+        for v in vals:
+            enc.append_uint53(v)
+        data = np.frombuffer(enc.buffer, np.uint8)
+        host = vdec.leb128_scan(data)
+        dev = vdec.leb128_scan_device(data)
+        for h, d in zip(host, dev):
+            assert np.array_equal(h, np.asarray(d))
+
+
+class TestDecodeCacheBudget:
+    def test_byte_budget_evicts(self):
+        cache = DecodeCache(100, name="test.cache.budget", max_bytes=100)
+        for i in range(10):
+            cache.put(bytes([i]) * 40, i)
+        assert len(cache) <= 3  # 40-byte keys under a 100-byte budget
+        assert cache._bytes <= 100
+        # the newest entries survive
+        assert cache.get(bytes([9]) * 40) == 9
+
+    def test_single_oversized_entry_still_caches(self):
+        cache = DecodeCache(8, name="test.cache.huge", max_bytes=64)
+        cache.put(b"x" * 1000, "huge")
+        assert cache.get(b"x" * 1000) == "huge"
+        assert len(cache) == 1
+
+    def test_entry_count_bound_still_applies(self):
+        cache = DecodeCache(3, name="test.cache.count", max_bytes=10**9)
+        for i in range(10):
+            cache.put(bytes([i]), i)
+        assert len(cache) == 3

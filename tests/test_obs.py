@@ -369,10 +369,27 @@ def test_farm_pad_waste_with_uneven_docs():
     with enabled_metrics():
         farm = TpuDocFarm(2, capacity=32)
         buf = _stream(1, 4)[0]
-        farm.apply_changes([[buf], []])  # doc 1 contributes zero rows
+        # doc 1 contributes zero rows: with paged storage it does not ride
+        # the dispatch at all, so an idle doc is no longer counted as pad
+        # waste (the old dense engine padded every doc to the batch width)
+        farm.apply_changes([[buf], []])
     assert reg.counter("farm.rows.transcoded").value == 4
-    assert reg.counter("farm.rows.padding").value == 4
-    assert reg.gauge("farm.pad_waste_ratio").value == pytest.approx(0.5)
+    assert reg.counter("farm.rows.padding").value == 0
+    assert reg.gauge("farm.pad_waste_ratio").value == pytest.approx(0.0)
+    # genuinely ragged ACTIVE docs still count: 4-row and 1-row docs pack
+    # to width 4, wasting 3 of 8 active cells
+    reg.reset()
+    with enabled_metrics():
+        farm = TpuDocFarm(2, capacity=32)
+        b4 = _stream(1, 4)[0]
+        b1 = _stream(1, 1, actor="bbbbbbbb")[0]
+        farm.apply_changes([[b4], [b1]])
+    assert reg.counter("farm.rows.transcoded").value == 5
+    assert reg.counter("farm.rows.padding").value == 3
+    assert reg.gauge("farm.pad_waste_ratio").value == pytest.approx(3 / 8)
+    # the slab-level figure of merit that supersedes pad waste: page
+    # occupancy of the allocated slab pages
+    assert reg.gauge("farm.pages.occupancy").value > 0
 
 
 def test_gate_deferral_and_prevalidation_abort_metrics():
