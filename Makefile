@@ -40,8 +40,10 @@ decode:
 
 # serving front-door demo (README "Serving"): 192 simulated clients over
 # the chaos transport in simulated time through the session multiplexer +
-# dynamic batcher; gates on convergence, batch occupancy and zero
-# unexplained sheds. The full-scale harness (10^4+ clients):
+# dynamic batcher; gates on convergence, batch occupancy, zero
+# unexplained sheds, a populated amscope phase breakdown with a p99
+# exemplar trace, and bounded observability overhead vs the metrics-only
+# baseline. The full-scale harness (10^4+ clients):
 # `python bench.py --serve`; also a tier-1 test (tests/test_serve_smoke.py)
 serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve --quick
@@ -50,6 +52,9 @@ native:
 	$(MAKE) -C native
 
 # span tree + metrics table for a small canned farm merge + sync
-# round-trip (automerge_tpu/obs; see README "Observability")
+# round-trip (automerge_tpu/obs; see README "Observability"). The CLI
+# contract — including the --flight timeline and --watch telemetry
+# renderers — is pinned in tier-1 by tests/test_obs_cli.py, so this
+# target cannot rot silently.
 obs-report:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.obs --docs 4 --rounds 2 --ops 8

@@ -29,7 +29,9 @@ Rule families (see core.RULES for the catalog):
   (AM203), captured-state mutation in traced code (AM204).
 - **AM3xx boundary**: host-only modules importing the device layer
   (AM301), hidden host syncs inside device profiling phases (AM302),
-  metric/span recording inside jit/vmap/Pallas-reachable code (AM303).
+  metric/span recording inside jit/vmap/Pallas-reachable code (AM303),
+  metric/event names out of sync with the README observability catalog
+  in either direction (AM304).
 - **AM4xx taxonomy/serve**: data-plane modules raising bare ValueError/
   TypeError instead of classifiable taxonomy errors (AM401); sync
   data-plane modules calling wall clocks or the global RNG directly
@@ -48,7 +50,7 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import boundary, hotpath, obsrules, packing, taxonomy, tracer
+from . import boundary, catalog, hotpath, obsrules, packing, taxonomy, tracer
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -80,7 +82,8 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
         except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
-    for family in (packing, tracer, boundary, obsrules, taxonomy, hotpath):
+    for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
+                   hotpath):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

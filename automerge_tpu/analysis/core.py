@@ -59,6 +59,10 @@ RULES: dict[str, tuple[str, str]] = {
     "AM303": ("boundary", "metric/span recording call inside jit/vmap/"
                           "Pallas-reachable code (record on the host "
                           "around the dispatch)"),
+    "AM304": ("boundary", "metric/event name recorded in code is missing "
+                          "from the README catalog, or a catalog row names "
+                          "nothing the code records (the observability "
+                          "contract must stay exact in both directions)"),
     "AM401": ("taxonomy", "bare ValueError/TypeError raised in a data-plane "
                           "module (raise a classifiable taxonomy error from "
                           "automerge_tpu.errors)"),

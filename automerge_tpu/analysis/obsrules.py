@@ -21,9 +21,10 @@ Flagged inside jit/vmap/Pallas-reachable code:
   ``profiling`` shim) — ``get_metrics()``, ``get_trace()``,
   ``use_profile(...)``, ...;
 - any attribute call spelling a recording verb: ``.inc()``, ``.observe()``,
-  ``.span()``, ``.phase()``. (``Gauge.set`` is deliberately NOT matched —
-  ``.set(...)`` is too common a spelling on host containers; gauges must
-  therefore be set in host code by convention.)
+  ``.span()``, ``.phase()``, ``.record()`` (the flight recorder's verb).
+  (``Gauge.set`` is deliberately NOT matched — ``.set(...)`` is too common
+  a spelling on host containers; gauges must therefore be set in host code
+  by convention.)
 """
 from __future__ import annotations
 
@@ -32,7 +33,7 @@ import ast
 from .core import FileContext, Finding, dotted_name
 from .tracer import _ModuleChecker
 
-_RECORD_ATTRS = {"inc", "observe", "span", "phase"}
+_RECORD_ATTRS = {"inc", "observe", "span", "phase", "record"}
 _OBS_MODULE_HINTS = {"obs", "metrics", "spans", "profiling"}
 
 

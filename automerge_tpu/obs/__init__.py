@@ -1,6 +1,7 @@
-"""amtrace — observability for the batched merge pipeline (SURVEY §5.1).
+"""amtrace + amscope — observability for the batched merge pipeline and
+the serving stack (SURVEY §5.1).
 
-The subsystem has two halves plus a CLI:
+Five parts plus a CLI:
 
 - **Spans** (`obs.spans`): nested wall-clock span trees with per-span call
   counts and fixed-bucket latency histograms (p50/p95/p99), ambient
@@ -9,14 +10,28 @@ The subsystem has two halves plus a CLI:
   thin compatibility shim over this layer — ``PhaseProfile`` /
   ``get_profile`` / ``use_profile`` keep working unchanged.
 - **Metrics** (`obs.metrics`): counters/gauges/histograms in one
-  process-wide registry — farm batch occupancy and pad waste, engine jit
-  cache hits vs recompiles, sync message/byte/Bloom accounting. Disabled
-  by default; recording costs one attribute test until a workload enables
-  the registry.
+  process-wide registry — farm batch occupancy, engine jit cache hits vs
+  recompiles, sync message/byte/Bloom accounting. Histogram buckets carry
+  **exemplars** (recent trace ids), so a p99 spike links to the request
+  trace behind it. Disabled by default; recording costs one attribute
+  test until a workload enables the registry.
+- **Request-flow tracing** (`obs.scope`, "amscope"): per-request trace
+  contexts attached at the serving front door and carried through the
+  batching window into the batched farm dispatch — one dispatch span
+  links the N request traces it served and carries the shared per-phase
+  breakdown; per-tenant accounting rides along.
+- **Flight recorder** (`obs.flight`): a bounded ring of structured events
+  (retransmits, watchdog escalations, quarantine transitions, flush
+  decisions, recompiles, slab growth), snapshot-dumped to JSONL on
+  faults for postmortems without re-running the workload.
+- **Live telemetry** (`obs.export`): Prometheus-style text exposition
+  (mounted on the asyncio adapter's telemetry port), periodic JSONL
+  snapshots, and the per-request phase-share math.
 - **CLI**: ``python -m automerge_tpu.obs`` runs a canned farm merge + sync
-  round-trip (or reads a dumped JSONL trace) and prints the span tree and
-  metrics table. See the README "Observability" section for the metric
-  catalog.
+  round-trip (or reads a dumped JSONL trace); ``--flight`` renders a
+  flight-recorder dump as a causal timeline; ``--watch`` renders live
+  telemetry snapshots top-style. See the README "Observability" section
+  for the metric and event catalogs (cross-checked by amlint AM304).
 
 Everything here is host-side and stdlib-only: importing ``obs`` never
 initialises jax, and amlint rule AM303 keeps instrument calls out of
@@ -25,6 +40,9 @@ jit/vmap/Pallas-reachable code.
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
 
+import contextlib
+
+from .flight import FlightRecorder, enabled_flight, get_flight
 from .metrics import (
     Counter,
     Gauge,
@@ -33,17 +51,45 @@ from .metrics import (
     enabled_metrics,
     get_metrics,
 )
+from .scope import (
+    Amscope,
+    DispatchSpan,
+    RequestScope,
+    enabled_amscope,
+    get_amscope,
+)
 from .spans import SpanNode, Trace, get_trace, use_trace
 
 __all__ = [
+    "Amscope",
     "Counter",
+    "DispatchSpan",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "RequestScope",
     "SpanNode",
     "Trace",
+    "enabled_amscope",
+    "enabled_flight",
     "enabled_metrics",
+    "enabled_observability",
+    "get_amscope",
+    "get_flight",
     "get_metrics",
     "get_trace",
     "use_trace",
 ]
+
+
+@contextlib.contextmanager
+def enabled_observability(flight_dir: str | None = None):
+    """Enables the whole observability stack — metrics registry, amscope
+    request tracing and the flight recorder — for the dynamic extent,
+    restoring every previous enabled state on exit. The one-call opt-in
+    the load harness and bench workloads use."""
+    with enabled_metrics(), enabled_amscope(), enabled_flight(
+        dump_dir=flight_dir
+    ):
+        yield

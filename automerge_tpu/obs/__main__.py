@@ -12,9 +12,20 @@ and renders it without running anything.
     python -m automerge_tpu.obs --dump trace.jsonl   # also write the trace
     python -m automerge_tpu.obs --trace trace.jsonl  # render a dump, no run
     python -m automerge_tpu.obs --json               # machine-readable
+    python -m automerge_tpu.obs --flight dump.jsonl  # flight timeline
+    python -m automerge_tpu.obs --watch snaps.jsonl  # live telemetry view
+    python -m automerge_tpu.obs --watch snaps.jsonl --follow
 
-The workload imports the device layer lazily, so ``--trace`` rendering
-works on hosts without jax initialisation. Exit code 0 on success.
+``--flight`` renders a flight-recorder dump (obs/flight.py) as a
+causally-ordered timeline. ``--watch`` renders the newest line of a
+telemetry snapshot file (obs/export.py: tenant table, per-request phase
+shares, flight-recorder tail) — once by default (headless/CI friendly),
+or refreshing top-style with ``--follow`` against a running server or
+load harness.
+
+The workload imports the device layer lazily, so ``--trace``/``--flight``
+/``--watch`` rendering works on hosts without jax initialisation. Exit
+code 0 on success.
 """
 from __future__ import annotations
 
@@ -24,6 +35,8 @@ import os
 import random
 import sys
 
+from .export import request_breakdown
+from .flight import load_jsonl, render_timeline
 from .metrics import enabled_metrics, get_metrics
 from .spans import Trace, use_trace
 
@@ -124,11 +137,98 @@ def run_workload(num_docs: int, rounds: int, ops_per_round: int) -> Trace:
     return trace
 
 
+def _render_watch_frame(record: dict) -> str:
+    """One --watch frame: header, per-request phase shares, the tenant
+    table and the flight-recorder tail, from a snapshot record."""
+    lines = [f"== amscope @ t={record.get('t', 0.0):.3f} =="]
+    breakdown = record.get("breakdown") or request_breakdown(
+        record.get("metrics", {})
+    )
+    lines.append("")
+    lines.append("-- phase shares (per request) --")
+    if breakdown.get("requests"):
+        shares = breakdown.get("shares", {})
+        mean = breakdown.get("mean_ms", {})
+        for phase in ("queue_wait", "dispatch", "readback", "assembly", "ack"):
+            share = shares.get(phase, 0.0)
+            bar = "#" * int(round(share * 40))
+            lines.append(
+                f"{phase:12} {share * 100:6.1f}%  {mean.get(phase, 0.0):9.3f} ms  {bar}"
+            )
+        lines.append(f"requests: {breakdown['requests']}")
+        if "p99_exemplar" in breakdown:
+            ex = breakdown["p99_exemplar"]
+            lines.append(
+                f"p99 {ex.get('p99_ms')} ms -> trace {ex.get('trace_id')}"
+            )
+    else:
+        lines.append("(no completed requests yet)")
+    lines.append("")
+    lines.append("-- tenants --")
+    tenants = record.get("tenants", {})
+    if tenants:
+        header = (
+            f"{'tenant':12}  {'requests':>8}  {'changes':>8}  {'bytes':>10}  "
+            f"{'shed':>6}  {'backpr':>6}  {'p99ms':>9}"
+        )
+        lines.append(header)
+        for name in sorted(tenants):
+            s = tenants[name]
+            lat = s.get("latency_ms", {})
+            p99 = lat.get("p99")
+            lines.append(
+                f"{name:12}  {s.get('requests', 0):>8}  "
+                f"{s.get('changes', 0):>8}  {s.get('bytes_in', 0):>10}  "
+                f"{s.get('shed', 0):>6}  {s.get('backpressure', 0):>6}  "
+                f"{'-' if p99 is None else format(p99, '.3g'):>9}"
+            )
+    else:
+        lines.append("(no tenant traffic)")
+    lines.append("")
+    lines.append("-- flight tail --")
+    tail = record.get("flight_tail", [])
+    lines.append(render_timeline(tail) if tail else "(no flight events)")
+    return "\n".join(lines)
+
+
+def _watch(path: str, follow: bool, interval: float) -> int:
+    """Renders the newest snapshot line of `path`; with --follow, keeps
+    re-reading and redrawing until interrupted (or the file vanishes)."""
+    import time as _time
+
+    last_rendered = None
+    while True:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+        except OSError as exc:
+            print(f"--watch: cannot read {path}: {exc}", file=sys.stderr)
+            return 1
+        if not lines:
+            print(f"--watch: {path} has no snapshots yet", file=sys.stderr)
+            if not follow:
+                return 1
+        else:
+            record = json.loads(lines[-1])
+            if lines[-1] != last_rendered:
+                last_rendered = lines[-1]
+                if follow:
+                    print("\033[2J\033[H", end="")
+                print(_render_watch_frame(record))
+        if not follow:
+            return 0
+        try:
+            _time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m automerge_tpu.obs",
-        description="amtrace: span tree + metrics report for a canned farm "
-                    "merge + sync round-trip (or a dumped trace)",
+        description="amtrace/amscope: span tree + metrics report for a "
+                    "canned farm merge + sync round-trip, a dumped trace, "
+                    "a flight-recorder timeline, or a live telemetry view",
     )
     parser.add_argument("--docs", type=int, default=4,
                         help="documents per farm (default 4)")
@@ -139,11 +239,34 @@ def main(argv=None) -> int:
     parser.add_argument("--trace", metavar="FILE",
                         help="render a JSON-lines trace dump instead of "
                              "running the workload")
+    parser.add_argument("--flight", metavar="FILE",
+                        help="render a flight-recorder JSONL dump as a "
+                             "causally-ordered timeline (no workload)")
+    parser.add_argument("--watch", metavar="FILE",
+                        help="render the newest telemetry snapshot in FILE "
+                             "(tenant table + phase shares + flight tail); "
+                             "headless one-frame render unless --follow")
+    parser.add_argument("--follow", action="store_true",
+                        help="with --watch: keep refreshing top-style")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="with --watch --follow: refresh seconds")
     parser.add_argument("--dump", metavar="FILE",
                         help="also write the span tree as JSON lines")
     parser.add_argument("--json", action="store_true",
                         help="print one JSON object instead of tables")
     args = parser.parse_args(argv)
+
+    if args.flight:
+        with open(args.flight, "r", encoding="utf-8") as fh:
+            events = load_jsonl(fh.read())
+        if args.json:
+            print(json.dumps({"events": events}, sort_keys=True))
+        else:
+            print(render_timeline(events))
+        return 0
+
+    if args.watch:
+        return _watch(args.watch, args.follow, args.interval)
 
     if args.trace:
         with open(args.trace, "r", encoding="utf-8") as fh:

@@ -45,6 +45,9 @@ class Counter:
             return
         self.value += n
 
+    def reset(self) -> None:
+        self.value = 0
+
     def snapshot(self):
         return {"type": "counter", "value": self.value}
 
@@ -65,15 +68,24 @@ class Gauge:
             return
         self.value = v
 
+    def reset(self) -> None:
+        self.value = 0.0
+
     def snapshot(self):
         return {"type": "gauge", "value": self.value}
 
 
 class Histogram:
     """Fixed-bucket distribution of positive floats (log2 grid shared with
-    the span layer)."""
+    the span layer).
 
-    __slots__ = ("name", "help", "enabled", "buckets", "count", "sum")
+    Each bucket may carry one **exemplar** — an opaque id (an amscope
+    trace/dispatch id) of a recent observation that landed in it — so a
+    percentile spike is one ``exemplar_for(q)`` lookup away from the
+    request trace that produced it."""
+
+    __slots__ = ("name", "help", "enabled", "buckets", "count", "sum",
+                 "exemplars")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
@@ -82,16 +94,20 @@ class Histogram:
         self.buckets: dict[int, int] = {}
         self.count = 0
         self.sum = 0.0
+        self.exemplars: dict[int, object] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar=None) -> None:
         if not self.enabled:
             return
         b = bucket_index(v)
         self.buckets[b] = self.buckets.get(b, 0) + 1
         self.count += 1
         self.sum += v
+        if exemplar is not None:
+            self.exemplars[b] = exemplar
 
-    def percentile(self, q: float) -> float | None:
+    def percentile_bucket(self, q: float) -> int | None:
+        """Bucket index holding the q-quantile, or None when empty."""
         if self.count == 0:
             return None
         threshold = q * self.count
@@ -99,11 +115,27 @@ class Histogram:
         for b in sorted(self.buckets):
             cum += self.buckets[b]
             if cum >= threshold:
-                return bucket_bounds(b)[1]
-        return bucket_bounds(max(self.buckets))[1]
+                return b
+        return max(self.buckets)
+
+    def percentile(self, q: float) -> float | None:
+        b = self.percentile_bucket(q)
+        return None if b is None else bucket_bounds(b)[1]
+
+    def exemplar_for(self, q: float):
+        """The exemplar recorded in the q-quantile's bucket (e.g. the
+        trace id behind the p99), or None when that bucket has none."""
+        b = self.percentile_bucket(q)
+        return None if b is None else self.exemplars.get(b)
+
+    def reset(self) -> None:
+        self.buckets = {}
+        self.count = 0
+        self.sum = 0.0
+        self.exemplars = {}
 
     def snapshot(self):
-        return {
+        out = {
             "type": "histogram",
             "count": self.count,
             "sum": self.sum,
@@ -111,6 +143,11 @@ class Histogram:
             "p95": self.percentile(0.95),
             "p99": self.percentile(0.99),
         }
+        if self.exemplars:
+            out["exemplars"] = {
+                str(b): e for b, e in sorted(self.exemplars.items())
+            }
+        return out
 
 
 class MetricsRegistry:
@@ -161,16 +198,17 @@ class MetricsRegistry:
             inst.enabled = False
 
     def reset(self) -> None:
-        """Zeroes every instrument (registrations and help text survive)."""
+        """Zeroes every instrument (registrations and help text survive).
+
+        Reset semantics are uniform: every instrument class owns its own
+        ``reset()`` and the registry only delegates, so a Counter's zero, a
+        Gauge's zero, and a Histogram's empty-percentile state (count 0,
+        ``percentile`` -> None, exemplars cleared) can never drift apart —
+        the reset-consistency bug class where a derived gauge survived a
+        reset its source counters did not (pinned by
+        tests/test_obs.py::test_reset_is_uniform_across_instrument_types)."""
         for inst in self._instruments.values():
-            if isinstance(inst, Counter):
-                inst.value = 0
-            elif isinstance(inst, Gauge):
-                inst.value = 0.0
-            elif isinstance(inst, Histogram):
-                inst.buckets = {}
-                inst.count = 0
-                inst.sum = 0.0
+            inst.reset()
 
     # ------------------------------------------------------------------ #
 

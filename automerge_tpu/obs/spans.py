@@ -143,7 +143,9 @@ class Trace:
 
     def totals_by_name(self) -> dict[str, tuple[float, int]]:
         """{name: (total_s, calls)} summed over every node of that name,
-        anywhere in the tree — the flat view the old PhaseProfile kept."""
+        anywhere in the tree — the flat view the old PhaseProfile kept.
+        Distinct-path spans that share a name are MERGED here; renderers
+        that must not lose per-path counts use ``totals_by_path``."""
         out: dict[str, tuple[float, int]] = {}
         stack = list(self.root.children.values())
         while stack:
@@ -151,6 +153,22 @@ class Trace:
             t, c = out.get(node.name, (0.0, 0))
             out[node.name] = (t + node.total_s, c + node.calls)
             stack.extend(node.children.values())
+        return out
+
+    def totals_by_path(self) -> dict[str, tuple[float, int]]:
+        """{"outer/inner": (total_s, calls)} — one entry per distinct tree
+        path (root children are bare names). Unlike ``totals_by_name``,
+        same-named spans under different parents keep their own totals and
+        call counts, so a flat renderer cannot silently merge them."""
+        out: dict[str, tuple[float, int]] = {}
+
+        def walk(node: SpanNode, prefix: str) -> None:
+            for child in node.children.values():
+                path = f"{prefix}/{child.name}" if prefix else child.name
+                out[path] = (child.total_s, child.calls)
+                walk(child, path)
+
+        walk(self.root, "")
         return out
 
     # ------------------------------------------------------------------ #

@@ -15,11 +15,15 @@ other's profiles). This module keeps the original surface working:
 
 ``PhaseProfile`` IS a ``Trace`` — phases recorded through it are spans
 (nesting under the ambient span), and the flat ``totals``/``counts``/
-``as_dict``/``table`` views aggregate the tree by name exactly like the
-old accumulator. ``get_profile()``/``use_profile()`` are the span layer's
-ambient accessors, so a profile installed here is the same object the
-farm's ``obs`` spans record into; `enabled=False` keeps the historical
-one-attribute-test disabled cost.
+``as_dict``/``table`` views aggregate the tree **by path** ("outer/inner"
+keys; top-level phases keep their bare names, so the bench's phase table
+is unchanged). Aggregating by *name* — the original shim behaviour —
+silently merged same-named spans that lived under different parents,
+losing their individual call counts in the table renderer; the path keys
+keep every distinct span visible. ``get_profile()``/``use_profile()`` are
+the span layer's ambient accessors, so a profile installed here is the
+same object the farm's ``obs`` spans record into; `enabled=False` keeps
+the historical one-attribute-test disabled cost.
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
@@ -34,25 +38,31 @@ class PhaseProfile(Trace):
 
     @property
     def totals(self) -> dict[str, float]:
-        return {name: t for name, (t, _) in self.totals_by_name().items()}
+        return {path: t for path, (t, _) in self.totals_by_path().items()}
 
     @property
     def counts(self) -> dict[str, int]:
-        return {name: c for name, (_, c) in self.totals_by_name().items()}
+        return {path: c for path, (_, c) in self.totals_by_path().items()}
 
     def as_dict(self) -> dict:
         return {
-            name: {"total_s": t, "calls": c}
-            for name, (t, c) in sorted(self.totals_by_name().items())
+            path: {"total_s": t, "calls": c}
+            for path, (t, c) in sorted(self.totals_by_path().items())
         }
 
     def table(self) -> str:
-        """Human-readable breakdown, largest phase first."""
-        flat = self.totals_by_name()
+        """Human-readable breakdown, largest phase first. Rows are keyed
+        by span PATH, so two same-named phases under different parents
+        render as two rows with their own times and call counts instead of
+        one silently merged row."""
+        flat = self.totals_by_path()
         if not flat:
             return "(no phases recorded)"
         width = max(len(n) for n in flat)
-        total = sum(t for t, _ in flat.values())
+        # total time = top-level spans only (nested spans are already
+        # inside their parents' wall time; summing every path would
+        # double-count and deflate every percentage)
+        total = sum(t for path, (t, _) in flat.items() if "/" not in path)
         lines = []
         for name in sorted(flat, key=lambda n: flat[n][0], reverse=True):
             t, calls = flat[name]

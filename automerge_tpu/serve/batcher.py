@@ -46,8 +46,13 @@ from ..errors import (
     SyncFrameError,
     SyncProtocolError,
 )
+from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
+from ..obs.scope import dispatch_context, get_amscope
 from ..sync import decode_sync_message
+
+_AMSCOPE = get_amscope()
+_FLIGHT = get_flight()
 
 _METRICS = get_metrics()
 _M_ADMITTED = _METRICS.counter(
@@ -152,13 +157,16 @@ class DynamicBatcher:
     # -------------------------------------------------------------- #
     # admission
 
-    def submit(self, channel, frame: bytes) -> None:
+    def submit(self, channel, frame: bytes, scope=None) -> None:
         """Admits one frame into the current window, or rejects it without
         queueing: ``AdmissionRejectedError`` when the channel's doc is
         farm-quarantined (shed; nothing the batch could do would commit),
         ``BackpressureError`` when the tenant's pending budget is full.
         Rejected frames are simply not acked — the session layer's
-        retransmission is the retry loop."""
+        retransmission is the retry loop. ``scope`` is the frame's amscope
+        trace context (None when request tracing is off); it rides the
+        window entry so the flush can price the queue wait and link the
+        request into the dispatch span."""
         if channel.doc in self.farm.quarantine:
             _M_ADM_QUARANTINE.inc()
             raise AdmissionRejectedError(
@@ -179,7 +187,7 @@ class DynamicBatcher:
             )
         if self._window_start is None:
             self._window_start = self.clock()
-        self._entries.append((channel, frame))
+        self._entries.append((channel, frame, scope))
         self._pending_by_tenant[tenant] = (
             self._pending_by_tenant.get(tenant, 0) + 1
         )
@@ -226,22 +234,29 @@ class DynamicBatcher:
         report = FlushReport()
         if not self._entries:
             return report
+        flush_reason = (
+            "count" if len(self._dirty_docs) >= self.config.max_docs
+            else "timer"
+        )
         entries, self._entries = self._entries, []
         self._dirty_docs = set()
         self._window_start = None
         _M_WINDOWS.inc()
+        now = self.clock()
 
         quarantined_before = set(self.farm.quarantine)
-        staged = []              # (channel, pre, msg) pending batched receive
+        staged = []      # (channel, pre, msg, scope) pending batched receive
         staged_docs = set()
         deferred = []
-        for channel, frame in entries:
+        for channel, frame, scope in entries:
             if channel.doc in quarantined_before:
                 # quarantined mid-window: excluded from the flush it was
                 # queued into; dropped unacked so the client retries later
                 report.shed_quarantined += 1
                 _M_SHED_QUARANTINED.inc()
                 self._consume(channel)
+                if scope is not None:
+                    _AMSCOPE.drop(scope, "shed")
                 continue
             try:
                 pre = channel.session.begin(frame)
@@ -249,10 +264,14 @@ class DynamicBatcher:
                 report.rejected += 1
                 _M_REJECTED.inc()
                 self._consume(channel)
+                if scope is not None:
+                    _AMSCOPE.drop(scope, "rejected")
                 continue
             if pre is None:
                 report.envelope_only += 1
                 self._consume(channel)
+                if scope is not None:
+                    _AMSCOPE.finish(scope, outcome="envelope")
                 continue
             if channel.doc in staged_docs:
                 # one payload per DOC per dispatch: a second channel of
@@ -262,7 +281,7 @@ class DynamicBatcher:
                 # The frame waits one window (begin's envelope effects
                 # are idempotent for an uncommitted payload; its seq is
                 # still unacked, so re-processing it is the normal path).
-                deferred.append((channel, frame))
+                deferred.append((channel, frame, scope))
                 continue
             try:
                 msg = decode_sync_message(pre["payload"])
@@ -272,8 +291,10 @@ class DynamicBatcher:
                 report.rejected += 1
                 _M_REJECTED.inc()
                 self._consume(channel)
+                if scope is not None:
+                    _AMSCOPE.drop(scope, "rejected")
                 continue
-            staged.append((channel, pre, msg))
+            staged.append((channel, pre, msg, scope))
             staged_docs.add(channel.doc)
             self._consume(channel)
 
@@ -283,40 +304,89 @@ class DynamicBatcher:
             report.deferred = len(deferred)
             _M_DEFERRED.inc(len(deferred))
             self._entries = deferred
-            self._dirty_docs = {c.doc for c, _ in deferred}
-            self._window_start = self.clock()
+            self._dirty_docs = {c.doc for c, _, _ in deferred}
+            self._window_start = now
+
+        if _FLIGHT.enabled:
+            _FLIGHT.record(
+                "batcher.flush", t=now, reason=flush_reason,
+                entries=len(entries), staged=len(staged),
+                docs=len(staged_docs), deferred=report.deferred,
+                shed=report.shed_quarantined, rejected=report.rejected,
+            )
 
         if staged:
             triples = [
                 (channel.doc, channel.session.state, pre["payload"])
-                for channel, pre, _ in staged
+                for channel, pre, _, _ in staged
             ]
             # ONE batched inner receive: every channel's changes route
-            # through a single farm.apply_changes(isolation="doc")
-            results = self.sync.receive_messages(triples)
+            # through a single farm.apply_changes(isolation="doc"). When
+            # request tracing is on, ONE DispatchSpan links every staged
+            # request trace and captures the farm's per-phase breakdown
+            # (the honest attribution for batched execution); the ambient
+            # dispatch context lets the farm's latency histograms stamp
+            # this span's id as their bucket exemplar.
+            span = None
+            if _AMSCOPE.enabled:
+                span = _AMSCOPE.begin_dispatch(
+                    [s.trace_id for _, _, _, s in staged if s is not None],
+                    now,
+                )
+                for _, _, _, scope in staged:
+                    if scope is not None:
+                        scope.mark("flush", now)
+                        scope.dispatch_id = span.dispatch_id
+                from ..profiling import PhaseProfile, use_profile
+
+                prof = PhaseProfile()
+                with dispatch_context(span), use_profile(prof):
+                    results = self.sync.receive_messages(triples)
+            else:
+                results = self.sync.receive_messages(triples)
+            committed_at = self.clock()
             report.outcomes = self.sync.last_apply
             change_docs = {
                 channel.doc
-                for (channel, _, msg) in staged
+                for (channel, _, msg, _) in staged
                 if msg["changes"]
             }
             report.changes_by_doc = {
                 channel.doc: list(msg["changes"])
-                for (channel, _, msg) in staged
+                for (channel, _, msg, _) in staged
                 if msg["changes"]
             }
             report.docs_dispatched = len(change_docs)
             report.changes_applied = sum(
-                len(msg["changes"]) for _, _, msg in staged
+                len(msg["changes"]) for _, _, msg, _ in staged
             )
             if change_docs:
                 _M_DISPATCHES.inc()
                 _M_OCCUPANCY.observe(len(change_docs))
                 _M_CHANGES.inc(report.changes_applied)
-            for (channel, pre, msg), (state, patch) in zip(staged, results):
+            if span is not None:
+                phases = {
+                    path: entry["total_s"]
+                    for path, entry in prof.as_dict().items()
+                    if "/" not in path  # farm phases open at the root
+                }
+                _AMSCOPE.end_dispatch(
+                    span, committed_at, phases=phases,
+                    docs=len(change_docs), changes=report.changes_applied,
+                )
+            for (channel, pre, msg, scope), (state, patch) in zip(
+                staged, results
+            ):
                 patch = channel.session.commit(pre, state, patch)
                 report.committed.append((channel, patch))
                 report.touched_docs.add(channel.doc)
+                if scope is not None:
+                    scope.mark("committed", committed_at)
+                    scope.changes = len(msg["changes"])
+                    scope.phases = span.phases if span is not None else None
+                    # the ack rides the next outbound frame; the server's
+                    # pump marks "sent" and finishes the scope
+                    channel.pending_scopes.append(scope)
 
         report.quarantined_docs = (
             set(self.farm.quarantine) - quarantined_before

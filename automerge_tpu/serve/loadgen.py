@@ -40,7 +40,10 @@ from dataclasses import dataclass, field
 
 from .. import backend as Backend
 from ..errors import AutomergeError, SyncProtocolError
+from ..obs.export import SnapshotWriter, request_breakdown
+from ..obs.flight import get_flight
 from ..obs.metrics import enabled_metrics, get_metrics
+from ..obs.scope import get_amscope
 from ..sync import decode_sync_message, encode_sync_message
 from ..sync_session import (
     BackendDriver,
@@ -91,6 +94,14 @@ class LoadConfig:
     tick: float = 0.01           # clock advance while traffic is moving
     batcher: BatcherConfig = field(default_factory=BatcherConfig)
     session: SessionConfig = field(default_factory=SessionConfig)
+    # observability stack for the run: "metrics" (the PR 7 baseline —
+    # metrics registry only), "full" (metrics + amscope request tracing +
+    # flight recorder), or "off" (nothing enabled: the library-user hot
+    # path, used by the bench overhead gate)
+    observability: str = "metrics"
+    flight_dir: str | None = None       # auto-dump dir for "full" runs
+    snapshot_path: str | None = None    # JSONL telemetry snapshots (--watch)
+    snapshot_interval: float = 0.5      # simulated seconds between snapshots
 
 
 class _Client:
@@ -145,6 +156,7 @@ class LoadGen:
         self._active: set[int] = set()
         self.shed_frames = 0
         self.rejected_down = 0
+        self._snapshots = None  # SnapshotWriter, armed by run()
 
     # -------------------------------------------------------------- #
     # fleet construction
@@ -346,14 +358,54 @@ class LoadGen:
 
     def run(self) -> dict:
         """Drives the fleet to convergence (or the simulated-time budget)
-        and returns the report. Metrics are force-enabled for the run so
-        the serve.* counters and latency histogram are always populated."""
+        and returns the report. ``config.observability`` picks the stack:
+        "metrics" enables the registry (the historical behaviour), "full"
+        adds amscope request tracing (phase breakdowns, exemplars, the
+        tenant table) and the flight recorder (auto-dumping to
+        ``flight_dir`` on quarantine/watchdog events), "off" enables
+        nothing — the disabled-hot-path shape the bench overhead gate
+        measures."""
+        import contextlib
+
         cfg = self.config
         # the registry is process-wide: zero it so the report reflects
         # exactly this run (the same convention as bench.py's workloads)
         _METRICS.reset()
-        with enabled_metrics():
+        scope, flight = get_amscope(), get_flight()
+        stack = contextlib.ExitStack()
+        if cfg.observability == "full":
+            scope.reset()
+            flight.clear()
+            was_clock, was_dir = flight.clock, flight.dump_dir
+            flight.clock = self.clock  # simulated-time timeline
+            stack.enter_context(enabled_metrics())
+            scope.enabled = True
+            stack.callback(lambda: setattr(scope, "enabled", False))
+            flight.enabled = True
+            if cfg.flight_dir is not None:
+                flight.dump_dir = cfg.flight_dir
+
+            def _restore_flight():
+                flight.enabled = False
+                flight.dump_dir = was_dir
+                flight.clock = was_clock
+
+            stack.callback(_restore_flight)
+        elif cfg.observability == "metrics":
+            stack.enter_context(enabled_metrics())
+        elif cfg.observability != "off":
+            raise ValueError(  # amlint: disable=AM401 — API-usage validation
+                f"unknown observability mode: {cfg.observability!r}"
+            )
+        self._snapshots = (
+            SnapshotWriter(cfg.snapshot_path, cfg.snapshot_interval,
+                           clock=self.clock)
+            if cfg.snapshot_path else None
+        )
+        with stack:
             converged = self._run_loop()
+            if self._snapshots is not None:
+                self._snapshots.write(self.clock())
         metrics = _METRICS.as_dict()
         surviving = self._surviving()
         unconverged = self._unconverged(surviving)
@@ -361,7 +413,15 @@ class LoadGen:
         dispatches = occupancy.get("count", 0)
         latency = metrics.get("serve.sync.latency_ms", {})
         committed = metrics.get("serve.batch.changes", {}).get("value", 0)
+        extras = {}
+        if cfg.observability == "full":
+            extras["breakdown"] = request_breakdown(metrics)
+            extras["tenants"] = scope.tenant_stats()
+            extras["dispatch_spans"] = len(scope.dispatches)
+            extras["flight_events"] = len(flight)
+            extras["flight_dumps"] = list(flight.dump_paths)
         return {
+            **extras,
             "clients": cfg.clients,
             "docs": cfg.docs,
             "edits": cfg.clients * cfg.edits_per_client,
@@ -401,6 +461,8 @@ class LoadGen:
         cfg = self.config
         idle_checks = 0
         while self.clock.now() < cfg.max_time:
+            if self._snapshots is not None:
+                self._snapshots.maybe_write(self.clock())
             moved = self._issue_due_edits()
             moved |= self._poll_clients()
             moved |= self._deliver_up()

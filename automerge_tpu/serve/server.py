@@ -34,13 +34,19 @@ from __future__ import annotations
 
 import random
 
-from ..errors import AutomergeError
+from ..errors import (
+    AdmissionRejectedError,
+    AutomergeError,
+    BackpressureError,
+)
 from ..obs.metrics import get_metrics
+from ..obs.scope import get_amscope
 from ..sync_session import SessionConfig, _default_clock
 from ..tpu.sync_farm import SyncFarm
 from .batcher import BatcherConfig, DynamicBatcher, FlushReport
 
 _METRICS = get_metrics()
+_AMSCOPE = get_amscope()
 _M_CONNECTS = _METRICS.counter(
     "serve.sessions.connected", "client channels opened (connect)"
 )
@@ -63,9 +69,11 @@ _M_FRAMES_OUT = _METRICS.counter(
 
 class ClientChannel:
     """One client's server-side state: its supervised session, target doc,
-    tenant (the admission-control dimension) and outbound frame queue."""
+    tenant (the admission-control dimension), outbound frame queue and the
+    committed request scopes awaiting their ack-send mark."""
 
-    __slots__ = ("client_id", "tenant", "doc", "session", "outbox")
+    __slots__ = ("client_id", "tenant", "doc", "session", "outbox",
+                 "pending_scopes")
 
     def __init__(self, client_id, tenant, doc, session):
         self.client_id = client_id
@@ -73,6 +81,10 @@ class ClientChannel:
         self.doc = doc
         self.session = session
         self.outbox: list[bytes] = []
+        # amscope: scopes committed by a flush whose ack has not left yet
+        # (the ack rides the channel's next outbound frame); empty and
+        # untouched when request tracing is off
+        self.pending_scopes: list = []
 
 
 class AmServer:
@@ -170,10 +182,29 @@ class AmServer:
         """Ingests one frame. Raises ``KeyError`` for unknown clients and
         the admission errors (``AdmissionRejectedError`` /
         ``BackpressureError``) when the batcher refuses the frame — the
-        caller drops it and the client's retransmission is the retry."""
+        caller drops it and the client's retransmission is the retry.
+
+        Request tracing attaches here: when amscope is enabled, the frame
+        gets a trace context (trace id, tenant, doc, client, bytes) that
+        rides the batching window into the batched dispatch; admission
+        rejections are counted against the tenant before re-raising."""
         channel = self.channels[client_id]
         _M_FRAMES_IN.inc()
-        self.batcher.submit(channel, frame)
+        scope = (
+            _AMSCOPE.attach(channel.tenant, channel.doc, client_id,
+                            t=self.clock(), nbytes=len(frame))
+            if _AMSCOPE.enabled else None
+        )
+        try:
+            self.batcher.submit(channel, frame, scope)
+        except AdmissionRejectedError:
+            if scope is not None:
+                _AMSCOPE.drop(scope, "shed")
+            raise
+        except BackpressureError:
+            if scope is not None:
+                _AMSCOPE.drop(scope, "backpressure")
+            raise
         self._active.add(client_id)
 
     def wake(self, client_id) -> None:
@@ -224,21 +255,40 @@ class AmServer:
             elif ready is not None:
                 out.append((client_id, ready))
                 _M_FRAMES_OUT.inc()
+                self._mark_sent(channel)
             elif channel.session.pending is None:
                 # quiet and nothing awaiting ack: sleep until woken
                 self._active.discard(client_id)
         if need_generate:
+            generate_t0 = self.clock()
             results = self.sync.generate_messages(
                 [(c.doc, c.session.state) for c in need_generate]
             )
+            if _AMSCOPE.enabled:
+                _AMSCOPE.observe_phase(
+                    "generate", self.clock() - generate_t0
+                )
             for channel, (state, payload) in zip(need_generate, results):
                 frame = channel.session.poll_commit(state, payload)
                 if frame is not None:
                     out.append((channel.client_id, frame))
                     _M_FRAMES_OUT.inc()
+                    self._mark_sent(channel)
                 elif channel.session.pending is None:
                     self._active.discard(channel.client_id)
         return out
+
+    def _mark_sent(self, channel: ClientChannel) -> None:
+        """Finishes the channel's committed request scopes: the outbound
+        frame just queued carries their ack, which ends the request's
+        journey (receive -> window -> dispatch -> commit -> ack-send).
+        One truthiness test when request tracing is off."""
+        if channel.pending_scopes:
+            now = self.clock()
+            for scope in channel.pending_scopes:
+                scope.mark("sent", now)
+                _AMSCOPE.finish(scope)
+            channel.pending_scopes.clear()
 
     def next_deadline(self) -> float | None:
         """The earliest future instant the core needs a ``tick``/``pump``
@@ -257,14 +307,36 @@ class AmServer:
     # -------------------------------------------------------------- #
     # asyncio adapter (real transports; the core above stays sans-io)
 
-    async def serve_forever(self, host: str = "127.0.0.1", port: int = 0):
+    async def serve_forever(self, host: str = "127.0.0.1", port: int = 0,
+                            *, telemetry_port: int | None = None,
+                            snapshot_path: str | None = None,
+                            snapshot_interval: float = 5.0):
         """Binds the core to asyncio streams: 4-byte big-endian length-
         prefixed frames, one connection per client. The first frame of a
         connection is a text hello ``b"HELLO <client_id> <doc> <tenant>"``;
         everything after is session frames. Runs until cancelled. Returns
         the listening server object (``server.sockets[0].getsockname()``
-        for the bound port)."""
+        for the bound port).
+
+        Live telemetry (obs/export.py): ``telemetry_port`` mounts the
+        pull-based text exposition (metrics + tenant table with
+        exemplars) on a side-car HTTP listener that never enters the
+        serving data path; ``snapshot_path`` appends a JSONL telemetry
+        snapshot every ``snapshot_interval`` seconds from the flusher
+        task — the file ``python -m automerge_tpu.obs --watch`` renders."""
         import asyncio
+
+        from ..obs.export import SnapshotWriter, serve_exposition
+
+        writer_snapshots = (
+            SnapshotWriter(snapshot_path, snapshot_interval,
+                           clock=self.clock)
+            if snapshot_path else None
+        )
+        telemetry = (
+            await serve_exposition(host, telemetry_port)
+            if telemetry_port is not None else None
+        )
 
         writers: dict[object, asyncio.StreamWriter] = {}
 
@@ -282,6 +354,8 @@ class AmServer:
                 await asyncio.sleep(self.batcher.config.flush_interval / 2)
                 self.tick()
                 await _send_all()
+                if writer_snapshots is not None:
+                    writer_snapshots.maybe_write()
 
         async def _handle(reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
@@ -320,4 +394,6 @@ class AmServer:
                 await server.serve_forever()
         finally:
             flusher.cancel()
+            if telemetry is not None:
+                telemetry.close()
         return server

@@ -61,6 +61,7 @@ from .errors import (
     SyncFrameError,
     SyncProtocolError,
 )
+from .obs.flight import get_flight
 from .obs.metrics import get_metrics
 from .sync import (
     decode_sync_message,
@@ -83,6 +84,11 @@ _CHECKSUM_SIZE = 4
 NEEDS_GENERATE = object()
 
 _METRICS = get_metrics()
+# flight-recorder hook (obs/flight.py): session events land in the ring
+# for postmortems. Every call site guards with `_FLIGHT.enabled` so the
+# disabled path never packs kwargs, and stamps `t` from the session's
+# injected clock so simulated-time runs produce simulated timelines.
+_FLIGHT = get_flight()
 _M_RETRANSMITS = _METRICS.counter(
     "sync.session.retransmits", "payload frames retransmitted after a timeout"
 )
@@ -354,11 +360,16 @@ class SyncSession:
                 ))
                 return None
             self.pending["attempt"] += 1
-            self.pending["deadline"] = (
-                now + self.config.timeout + self._backoff(self.pending["attempt"])
-            )
+            backoff = self._backoff(self.pending["attempt"])
+            self.pending["deadline"] = now + self.config.timeout + backoff
             _M_RETRANSMITS.inc()
             self.stats["retransmits"] += 1
+            if _FLIGHT.enabled:
+                _FLIGHT.record(
+                    "session.retransmit", t=now, seq=self.pending["seq"],
+                    attempt=self.pending["attempt"],
+                    backoff_ms=round(backoff * 1000.0, 3),
+                )
             self.ack_owed = False
             # re-frame so the retransmission carries the current ack
             return encode_frame(
@@ -489,6 +500,9 @@ class SyncSession:
         dup-drop/heads mismatch."""
         _M_PEER_RESTARTS.inc()
         self.stats["peer_restarts"] += 1
+        if _FLIGHT.enabled:
+            _FLIGHT.record("session.peer_restart", t=self.clock(),
+                           epoch=self.epoch, peer_epoch=self.peer_epoch)
         self.last_seen = 0
         self.pending = None  # addressed to the old incarnation; regenerate
         self._acked_payload = None  # the new incarnation acked nothing
@@ -526,6 +540,9 @@ class SyncSession:
         self.stats["stalls"] += 1
         _M_WD_ESCALATIONS.inc()
         self.stats["escalations"] += 1
+        if _FLIGHT.enabled:
+            _FLIGHT.record("watchdog.stall", t=self.clock(),
+                           epoch=self.epoch, stage=self._wd_stage)
         self._acked_payload = None  # escalations must retransmit freely
         if self._wd_stage == 0:
             # stage 1 — rebuild the Bloom exchange: clearing sentHashes and
@@ -533,6 +550,9 @@ class SyncSession:
             # re-offer anything wrongly withheld (e.g. a change a stale
             # sentHashes entry or a Bloom false-positive loop suppressed)
             self._wd_stage = 1
+            if _FLIGHT.enabled:
+                _FLIGHT.record("watchdog.escalate", t=self.clock(),
+                               epoch=self.epoch, action="bloom_rebuild")
             self.state = dict(self.state, lastSentHeads=[], sentHashes={})
         else:
             # stage 2 — full reset exchange: treat the peer's filter as
@@ -541,6 +561,11 @@ class SyncSession:
             self._wd_stage = 0
             _M_WD_RESETS.inc()
             self.stats["resets"] += 1
+            if _FLIGHT.enabled:
+                _FLIGHT.record("watchdog.reset", t=self.clock(),
+                               epoch=self.epoch)
+                _FLIGHT.trigger("watchdog.reset", t=self.clock(),
+                                epoch=self.epoch)
             self.state = dict(
                 self.state,
                 sharedHeads=[], lastSentHeads=[], sentHashes={},
@@ -560,6 +585,11 @@ class SyncSession:
         self.pending = None
         _M_CHQ_ENTERED.inc()
         _set_active_quarantined()
+        if _FLIGHT.enabled:
+            _FLIGHT.record("session.quarantine.enter", t=self.clock(),
+                           epoch=self.epoch, cause=str(cause))
+            _FLIGHT.trigger("session.quarantine", t=self.clock(),
+                            epoch=self.epoch)
 
     def release(self):
         """Returns a quarantined channel to service with a fresh retry
@@ -576,6 +606,9 @@ class SyncSession:
         self._acked_payload = None  # post-heal recovery regenerates freely
         _M_CHQ_RELEASED.inc()
         _set_active_quarantined()
+        if _FLIGHT.enabled:
+            _FLIGHT.record("session.quarantine.release", t=self.clock(),
+                           epoch=self.epoch)
 
     def check(self):
         """Raises ``ChannelQuarantinedError`` if the channel is shed (the

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
 from ..testing.faults import fire as _fault_point
 
@@ -65,6 +66,23 @@ _M_STATE_GROWS = _METRICS.counter(
     "capacity doublings of the dense device state",
 )
 
+# flight-recorder hook (obs/flight.py): recompiles and slab growth are the
+# two engine events worth a postmortem timeline entry — a steady-state
+# recompile storm or a surprise slab doubling explains a latency cliff.
+_FLIGHT = get_flight()
+
+
+def _shape_bucket(args, kwargs):
+    """The (sorted, deduplicated) array shapes of a dispatch's arguments —
+    the compile-cache key's footprint, recorded on recompile events so the
+    flight timeline names WHICH shape bucket missed."""
+    shapes = {
+        tuple(leaf.shape)
+        for leaf in jax.tree_util.tree_leaves((args, kwargs))
+        if hasattr(leaf, "shape")
+    }
+    return sorted(shapes)
+
 
 def _dispatch(jitted, *args, **kwargs):
     """Runs a jitted entry point, classifying the call as a jit cache hit
@@ -82,6 +100,13 @@ def _dispatch(jitted, *args, **kwargs):
         grew = size_fn() - before
         if grew > 0:
             _M_JIT_RECOMPILES.inc(grew)
+            if _FLIGHT.enabled:
+                _FLIGHT.record(
+                    "engine.recompile",
+                    fn=getattr(jitted, "__name__", repr(jitted)),
+                    shapes=_shape_bucket(args, kwargs),
+                    cache_size=size_fn(),
+                )
         else:
             _M_JIT_HITS.inc()
     return out
@@ -474,6 +499,10 @@ class BatchedMapEngine:
         if self.pages.ensure(sum(e for e in extra if e > 0)):
             self.slab = grow_slab(self.slab, self.pages.num_pages * P)
             _M_STATE_GROWS.inc()
+            if _FLIGHT.enabled:
+                _FLIGHT.record("engine.slab.grow",
+                               pages=self.pages.num_pages,
+                               rows=self.pages.num_pages * P)
         fresh: list = []
         new_tables = []
         for t, e in zip(old_tables, extra):

@@ -56,6 +56,7 @@ historical all-or-nothing contract. See README "Fault isolation".
 """
 from __future__ import annotations
 
+import time
 from typing import NamedTuple
 
 import numpy as np
@@ -70,7 +71,9 @@ from ..errors import (
     QuarantinedError,
     error_kind,
 )
+from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
+from ..obs.scope import current_exemplar
 from ..opset import OpSet
 from ..testing.faults import fire as _fault_point
 from .engine import (
@@ -180,6 +183,23 @@ _M_VECTOR_ROWS = _METRICS.counter(
     "farm.assembly.vector_rows",
     "rows processed by the vectorized (column-mask) assembly path",
 )
+# amscope hooks: the dispatch/readback latency histograms carry the
+# ambient serve DispatchSpan id as their bucket exemplar, so a farm-side
+# latency spike links back to the batched request traces it served.
+_M_DISPATCH_MS = _METRICS.histogram(
+    "farm.dispatch.latency_ms",
+    "host-measured batched device merge dispatch latency; exemplars name "
+    "the owning serve dispatch span",
+)
+_M_READBACK_MS = _METRICS.histogram(
+    "farm.readback.latency_ms",
+    "host-measured scoped visibility readback latency; exemplars name "
+    "the owning serve dispatch span",
+)
+# flight-recorder hook (obs/flight.py): quarantine transitions and device
+# faults leave timeline events (with the offending change hashes) and
+# auto-dump the ring for postmortems.
+_FLIGHT = get_flight()
 
 # One counter family for every per-doc quarantine cause, dimensioned by the
 # taxonomy's error_kind (decode/checksum/causality/packing/device/...): the
@@ -808,6 +828,16 @@ class TpuDocFarm:
                 self.quarantine[d] = exc
                 _M_Q_ENTERED.inc()
                 _M_Q_ACTIVE.set(len(self.quarantine))
+                if _FLIGHT.enabled:
+                    _FLIGHT.record(
+                        "farm.quarantine.enter", doc=d,
+                        kind=error_kind(exc),
+                        offending_hashes=list(
+                            getattr(exc, "offending_hashes", ())
+                        ),
+                        failures=self.fault_counts[d],
+                    )
+                    _FLIGHT.trigger("farm.quarantine", doc=d)
 
         # quarantined docs shed their traffic before any work happens
         if doc_mode and self.quarantine:
@@ -996,7 +1026,13 @@ class TpuDocFarm:
             with prof.phase("device_dispatch"):
                 try:
                     _fault_point("farm.device_dispatch", docs=active)
+                    dispatch_t0 = time.perf_counter()
                     self.engine.apply_batch(batch, docs=active, counts=counts)
+                    if _METRICS.enabled:
+                        _M_DISPATCH_MS.observe(
+                            (time.perf_counter() - dispatch_t0) * 1000.0,
+                            exemplar=current_exemplar(),
+                        )
                 except Exception as exc:
                     if not doc_mode:
                         raise
@@ -1007,6 +1043,10 @@ class TpuDocFarm:
                     # sequential reference walk below.
                     device_failed = True
                     _M_FB_CALLS.inc()
+                    if _FLIGHT.enabled:
+                        _FLIGHT.record("farm.device_fault",
+                                       docs=list(active), error=str(exc))
+                        _FLIGHT.trigger("farm.device_fault")
                     poison = self._bisect_device_faults(per_doc_arrays, active)
                     for d in sorted(poison):
                         quarantine(d, DeviceFaultError(
@@ -1280,6 +1320,8 @@ class TpuDocFarm:
                 released.append(d)
                 _M_Q_RELEASED.inc()
         _M_Q_ACTIVE.set(len(self.quarantine))
+        if released and _FLIGHT.enabled:
+            _FLIGHT.record("farm.quarantine.release", docs=released)
         return released
 
     # ------------------------------------------------------------------ #
@@ -1359,9 +1401,15 @@ class TpuDocFarm:
         if not plan:
             return
         rank = self._actor_rank() if self.actors.table else None
+        readback_t0 = time.perf_counter()
         visible, totals = self.engine.read_visibility_rows(
             plan, actor_rank=rank
         )
+        if _METRICS.enabled:
+            _M_READBACK_MS.observe(
+                (time.perf_counter() - readback_t0) * 1000.0,
+                exemplar=current_exemplar(),
+            )
         offset = 0
         for d, idx in plan:
             n = idx.shape[0]

@@ -389,7 +389,8 @@ def _quick_main():
 
 
 def bench_serve(clients, docs, edits, ops, spread, chaos=0.0, poison=0.0,
-                seed=0):
+                seed=0, observability="full", flight_dir=None,
+                snapshot_path=None):
     """The serving front door under load (README "Serving"): `clients`
     simulated editors drive an AmServer over per-client chaos links in
     simulated time (serve/loadgen.py). The batcher turns their sync
@@ -397,7 +398,11 @@ def bench_serve(clients, docs, edits, ops, spread, chaos=0.0, poison=0.0,
     p99 sync latency (simulated ms — what a client feels, batching window
     included), e2e ops/s (committed ops per HOST second — what the
     serving stack costs), and batch occupancy (docs per dispatch — the
-    density the batcher exists to create)."""
+    density the batcher exists to create). With ``observability="full"``
+    (the default) the report also carries amscope's per-request phase
+    breakdown, the p99 exemplar trace, the per-tenant table and the
+    flight-recorder dump list; ``"metrics"`` is the PR 7 baseline stack
+    and ``"off"`` the disabled hot path (the overhead gate's shapes)."""
     from automerge_tpu.serve.loadgen import LoadConfig, LoadGen
     from automerge_tpu.tpu.farm import TpuDocFarm
 
@@ -407,7 +412,8 @@ def bench_serve(clients, docs, edits, ops, spread, chaos=0.0, poison=0.0,
     config = LoadConfig(
         clients=clients, docs=docs, edits_per_client=edits,
         ops_per_edit=ops, spread=spread, chaos=chaos, poison=poison,
-        seed=seed,
+        seed=seed, observability=observability, flight_dir=flight_dir,
+        snapshot_path=snapshot_path,
     )
     harness = LoadGen(farm, config)
     start = time.perf_counter()
@@ -416,7 +422,7 @@ def bench_serve(clients, docs, edits, ops, spread, chaos=0.0, poison=0.0,
     surviving_ops = (
         report["surviving_clients"] * edits * ops
     )
-    report["host_s"] = round(elapsed, 2)
+    report["host_s"] = round(elapsed, 3)
     report["e2e_ops_per_sec"] = round(surviving_ops / elapsed) if elapsed else 0
     report["sim_ops_per_sec"] = (
         round(surviving_ops / report["simulated_s"])
@@ -431,9 +437,15 @@ def _serve_main(quick):
     machine-independent properties — everything below runs in simulated
     time off one seed, so the numbers are reproducible anywhere:
     convergence of every client's heads, batch occupancy >= the floor,
-    and zero unexplained sheds (no admission rejects without poison)."""
+    zero unexplained sheds (no admission rejects without poison), a
+    populated per-request phase breakdown with an exemplar-linked p99
+    trace (amscope), and bounded observability overhead — the same
+    workload is run once on the PR 7 baseline stack (metrics only) and
+    once with amscope+flight on, and the full stack's host time must stay
+    within BENCH_SERVE_OBS_OVERHEAD x the baseline's."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     floor = float(os.environ.get("BENCH_SERVE_OCCUPANCY_FLOOR", "8"))
+    overhead_cap = float(os.environ.get("BENCH_SERVE_OBS_OVERHEAD", "2.0"))
     if quick:
         clients, docs, edits, ops, spread = 192, 32, 2, 4, 0.4
         chaos = poison = 0.0
@@ -445,17 +457,46 @@ def _serve_main(quick):
         spread = float(os.environ.get("BENCH_SERVE_SPREAD", "2.0"))
         chaos = float(os.environ.get("BENCH_SERVE_CHAOS", "0"))
         poison = float(os.environ.get("BENCH_SERVE_POISON", "0"))
-    report = bench_serve(clients, docs, edits, ops, spread,
-                         chaos=chaos, poison=poison)
+    obs_overhead = None
+    if quick:
+        # the measured-overhead gate: identical seeded workload on the
+        # PR 7 baseline stack, then with amscope + flight recorder on.
+        # A throwaway warm-up run eats the jit compiles first so both
+        # measured runs see the same warm program cache.
+        bench_serve(clients, docs, edits, ops, spread,
+                    chaos=chaos, poison=poison, observability="off")
+        baseline = bench_serve(clients, docs, edits, ops, spread,
+                               chaos=chaos, poison=poison,
+                               observability="metrics")
+        report = bench_serve(clients, docs, edits, ops, spread,
+                             chaos=chaos, poison=poison,
+                             observability="full")
+        obs_overhead = {
+            "baseline_host_s": baseline["host_s"],
+            "amscope_host_s": report["host_s"],
+            "ratio": round(
+                report["host_s"] / baseline["host_s"], 3
+            ) if baseline["host_s"] else 1.0,
+            "cap": overhead_cap,
+        }
+    else:
+        report = bench_serve(clients, docs, edits, ops, spread,
+                             chaos=chaos, poison=poison,
+                             observability="full")
     unexplained_sheds = (
         report["admission"]["rejected_quarantine"]
         + report["admission"]["shed_mid_window"]
         if poison == 0 else 0
     )
+    breakdown = report.get("breakdown", {})
     ok = (
         report["converged"]
         and report["occupancy_mean"] >= floor
         and unexplained_sheds == 0
+        and breakdown.get("requests", 0) > 0
+        and breakdown.get("p99_exemplar", {}).get("trace_id") is not None
+        and (obs_overhead is None
+             or obs_overhead["ratio"] <= overhead_cap)
     )
     print(json.dumps({
         "metric": "served sync throughput (batched front door, e2e ops/sec)",
@@ -478,6 +519,9 @@ def _serve_main(quick):
         "occupancy_floor": floor,
         "admission": report["admission"],
         "frames_shed": report["frames_shed"],
+        "breakdown": breakdown,
+        "tenants": report.get("tenants", {}),
+        "obs_overhead": obs_overhead,
     }))
     if quick:
         sys.exit(0 if ok else 1)

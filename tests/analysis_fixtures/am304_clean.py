@@ -1,0 +1,9 @@
+"""AM304 clean fixture: every recorded name has its README catalog row."""
+# amlint: metric-catalog
+from automerge_tpu.obs.flight import get_flight
+from automerge_tpu.obs.metrics import get_metrics
+
+
+def work():
+    get_metrics().counter("farm.changes.applied").inc()
+    get_flight().record("batcher.flush", reason="timer")

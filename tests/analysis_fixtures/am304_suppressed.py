@@ -1,0 +1,10 @@
+"""AM304 suppressed fixture: an uncataloged name under a justified
+suppression (e.g. an experiment-local metric that must not enter the
+operator contract yet)."""
+# amlint: metric-catalog
+from automerge_tpu.obs.metrics import get_metrics
+
+
+def work():
+    # amlint: disable=AM304 — experiment-local metric, not yet an operator contract
+    get_metrics().counter("fixture.experimental.metric").inc()

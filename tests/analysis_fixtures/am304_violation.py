@@ -1,0 +1,10 @@
+"""AM304 violating fixture: records a metric name with no README catalog
+row (and a flight event with no event-catalog row)."""
+# amlint: metric-catalog
+from automerge_tpu.obs.flight import get_flight
+from automerge_tpu.obs.metrics import get_metrics
+
+
+def work():
+    get_metrics().counter("fixture.not_in.catalog").inc()
+    get_flight().record("fixture.uncataloged.event", doc=1)

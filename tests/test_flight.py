@@ -1,0 +1,240 @@
+"""Flight-recorder suite (automerge_tpu/obs/flight.py + fault-path
+integration).
+
+Covers the ISSUE 8 contract:
+- the ring is bounded and causally ordered (global seq survives wraps);
+- auto-dump: entering farm quarantine, a device fault, channel
+  quarantine and a watchdog reset each snapshot the ring to JSONL;
+- a chaos+poison loadgen run auto-dumps a timeline containing the
+  quarantine events that occurred (the acceptance-criteria shape);
+- the ``--flight`` CLI renders a dump as a causally-ordered timeline.
+"""
+import json
+import os
+import random
+
+import pytest
+
+from automerge_tpu.obs.flight import (
+    FlightRecorder,
+    enabled_flight,
+    get_flight,
+    load_jsonl,
+    render_timeline,
+)
+from automerge_tpu.serve.loadgen import LoadConfig, LoadGen
+from automerge_tpu.testing.faults import bit_flipped
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+
+def _stream(rounds, ops, actor="aaaaaaaa", seed=0):
+    from automerge_tpu.obs.__main__ import _change_stream
+
+    return _change_stream(actor, rounds, ops, seed=seed)
+
+
+# ---------------------------------------------------------------------- #
+# ring mechanics
+
+def test_ring_is_bounded_and_causally_ordered():
+    rec = FlightRecorder(capacity=8, clock=lambda: 0.0)
+    rec.enabled = True
+    for i in range(20):
+        rec.record("batcher.flush", t=float(i), n=i)
+    assert len(rec) == 8
+    events = rec.snapshot()
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    assert events[0]["fields"]["n"] == 12  # oldest 12 fell off
+    assert events[-1]["fields"]["n"] == 19
+
+
+def test_jsonl_round_trip_and_timeline_render():
+    rec = FlightRecorder(clock=lambda: 1.25)
+    rec.enabled = True
+    rec.record("engine.slab.grow", pages=32, rows=2048)
+    rec.record("session.retransmit", t=2.0, seq=4, attempt=1,
+               backoff_ms=120.5)
+    events = load_jsonl(rec.to_jsonl())
+    assert [e["event"] for e in events] == [
+        "engine.slab.grow", "session.retransmit"
+    ]
+    assert events[0]["t"] == 1.25  # recorder clock default
+    table = render_timeline(events)
+    assert "engine.slab.grow" in table and "backoff_ms=120.5" in table
+    assert render_timeline([]) == "(no flight events)"
+
+
+def test_trigger_dumps_bounded_files(tmp_path):
+    rec = FlightRecorder(clock=lambda: 0.0)
+    rec.enabled = True
+    rec.dump_dir = str(tmp_path)
+    rec.record("batcher.flush", reason="timer")
+    path = rec.trigger("farm.quarantine", doc=3)
+    assert path is not None and os.path.exists(path)
+    events = load_jsonl(open(path, encoding="utf-8").read())
+    assert events[-1]["event"] == "flight.trigger"
+    assert events[-1]["fields"]["reason"] == "farm.quarantine"
+    assert any(e["event"] == "batcher.flush" for e in events)
+    # the dump budget bounds file count
+    from automerge_tpu.obs import flight as flight_mod
+
+    for _ in range(flight_mod.MAX_AUTO_DUMPS + 4):
+        rec.trigger("farm.quarantine")
+    assert len(rec.dump_paths) == flight_mod.MAX_AUTO_DUMPS
+
+
+def test_trigger_without_dump_dir_still_records():
+    rec = FlightRecorder()
+    rec.enabled = True
+    rec.dump_dir = None
+    assert rec.trigger("watchdog.reset") is None
+    assert rec.snapshot()[-1]["event"] == "flight.trigger"
+
+
+# ---------------------------------------------------------------------- #
+# fault-path integration: the auto-dump sources
+
+def test_farm_quarantine_entry_records_and_dumps(tmp_path):
+    """Entering the farm's quarantine set leaves a farm.quarantine.enter
+    event (with the offending hashes) and auto-dumps the ring."""
+    with enabled_flight(dump_dir=str(tmp_path)) as rec:
+        rec.clear()
+        farm = TpuDocFarm(2, capacity=32, quarantine_threshold=1)
+        good = _stream(1, 4)[0]
+        bad = bytes(bit_flipped(good))
+        farm.apply_changes([[good], [bad]])
+        events = rec.snapshot()
+    kinds = [e["event"] for e in events]
+    assert "farm.quarantine.enter" in kinds
+    enter = next(e for e in events if e["event"] == "farm.quarantine.enter")
+    assert enter["fields"]["doc"] == 1
+    assert enter["fields"]["kind"]
+    assert rec.dump_paths, "quarantine entry did not dump"
+    dumped = load_jsonl(open(rec.dump_paths[0], encoding="utf-8").read())
+    assert any(e["event"] == "farm.quarantine.enter" for e in dumped)
+    # release leaves its event too
+    with enabled_flight():
+        farm.release_quarantine()
+        assert get_flight().snapshot()[-1]["event"] == "farm.quarantine.release"
+
+
+def test_session_retry_exhaustion_records_and_dumps(tmp_path):
+    """A channel burning its retry budget leaves retransmit events and a
+    session.quarantine.enter, and dumps the ring."""
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.sync_session import (
+        BackendDriver,
+        SessionConfig,
+        SyncSession,
+    )
+    from automerge_tpu.testing.chaos import ManualClock
+
+    clock = ManualClock()
+    with enabled_flight(dump_dir=str(tmp_path)) as rec:
+        rec.clear()
+        session = SyncSession(
+            BackendDriver(Backend.init()), clock=clock,
+            rng=random.Random(0),
+            config=SessionConfig(timeout=1.0, max_retries=2,
+                                 backoff_base=0.1, backoff_cap=0.2),
+        )
+        # generate one payload frame; never ack it
+        assert session.poll() is not None
+        for _ in range(8):
+            clock.advance(5.0)
+            session.poll()
+            if session.quarantined:
+                break
+        assert session.quarantined
+        events = rec.snapshot()
+    kinds = [e["event"] for e in events]
+    assert kinds.count("session.retransmit") >= 2
+    assert "session.quarantine.enter" in kinds
+    # timestamps came from the injected (simulated) clock
+    retransmit = next(e for e in events
+                      if e["event"] == "session.retransmit")
+    assert retransmit["t"] >= 5.0
+    assert rec.dump_paths
+    # release leaves its event
+    with enabled_flight():
+        session.release()
+        assert get_flight().snapshot()[-1]["event"] == \
+            "session.quarantine.release"
+
+
+def test_engine_recompile_event_names_shape_bucket():
+    with enabled_flight() as rec:
+        rec.clear()
+        farm = TpuDocFarm(2, capacity=32)
+        from automerge_tpu.obs.metrics import enabled_metrics
+
+        with enabled_metrics():
+            buf = _stream(1, 4)[0]
+            farm.apply_changes([[buf], [buf]])
+        events = [e for e in rec.snapshot()
+                  if e["event"] == "engine.recompile"]
+    assert events, "fresh shapes compiled without a recompile event"
+    assert events[0]["fields"]["fn"]
+    assert events[0]["fields"]["shapes"]
+
+
+# ---------------------------------------------------------------------- #
+# acceptance shape: chaos+poison loadgen auto-dumps a usable timeline
+
+@pytest.fixture(scope="module")
+def poison_run(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("flight")
+    farm = TpuDocFarm(8, capacity=128)
+    gen = LoadGen(farm, LoadConfig(
+        clients=24, docs=8, edits_per_client=2, ops_per_edit=3,
+        spread=0.5, chaos=0.15, poison=0.25, seed=5,
+        observability="full", flight_dir=str(tmp),
+    ))
+    report = gen.run()
+    return {"report": report, "farm": farm}
+
+
+def test_poison_run_quarantines_and_dumps(poison_run):
+    report = poison_run["report"]
+    assert report["quarantined_docs"] > 0
+    assert report["flight_dumps"], "no flight dump despite quarantines"
+    for path in report["flight_dumps"]:
+        assert os.path.exists(path)
+
+
+def test_poison_run_timeline_contains_the_quarantine_events(poison_run):
+    """The acceptance criterion: the auto-dumped timeline contains the
+    quarantine (and any watchdog) events that occurred, causally
+    ordered, and renders."""
+    path = poison_run["report"]["flight_dumps"][-1]
+    events = load_jsonl(open(path, encoding="utf-8").read())
+    kinds = {e["event"] for e in events}
+    assert "farm.quarantine.enter" in kinds
+    assert "batcher.flush" in kinds
+    assert "flight.trigger" in kinds
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+    quarantined_docs = {
+        e["fields"]["doc"] for e in events
+        if e["event"] == "farm.quarantine.enter"
+    }
+    assert quarantined_docs <= set(poison_run["farm"].quarantine) | \
+        quarantined_docs  # every event names a doc the farm quarantined
+    assert quarantined_docs & set(poison_run["farm"].quarantine)
+    table = render_timeline(events)
+    assert "farm.quarantine.enter" in table
+
+
+def test_flight_cli_renders_dump(poison_run, capsys):
+    from automerge_tpu.obs.__main__ import main
+
+    path = poison_run["report"]["flight_dumps"][-1]
+    assert main(["--flight", path]) == 0
+    out = capsys.readouterr().out
+    assert "farm.quarantine.enter" in out
+    assert "seq" in out.splitlines()[0]
+    # machine-readable variant
+    assert main(["--flight", path, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert any(e["event"] == "flight.trigger" for e in payload["events"])

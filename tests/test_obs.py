@@ -93,21 +93,45 @@ def test_phase_profile_table_single_phase():
     assert "x1" in table
 
 
-def test_phase_profile_nested_spans_aggregate_by_name():
+def test_phase_profile_flat_views_aggregate_by_path():
+    """The shim's flat views key by PATH: a nested "b" and a top-level
+    "b" are different rows (the by-name views merged them, losing the
+    distinction); top-level phases keep their bare names, so the bench's
+    phase table is unchanged."""
     prof = PhaseProfile()
     with prof.phase("a"):
         with prof.phase("b"):
             pass
     with prof.phase("b"):
         pass
-    assert prof.counts == {"a": 1, "b": 2}
+    assert prof.counts == {"a": 1, "a/b": 1, "b": 1}
     d = prof.as_dict()
-    assert sorted(d) == ["a", "b"]
-    assert d["b"]["calls"] == 2
+    assert sorted(d) == ["a", "a/b", "b"]
+    assert d["a/b"]["calls"] == 1
     assert d["b"]["total_s"] >= 0.0
-    # the flat table carries both names whatever the nesting
+    # the flat table carries every path whatever the nesting
     table = prof.table()
-    assert "x2" in table and "a" in table and "b" in table
+    assert "a/b" in table and "x1" in table
+
+
+def test_table_keeps_sibling_same_name_span_counts():
+    """The renderer regression behind the by-path change: two same-named
+    spans under different parents used to merge into one row whose count
+    (x2) lost the fact that each path ran once."""
+    prof = PhaseProfile()
+    with prof.phase("m"):
+        with prof.phase("x"):
+            pass
+    with prof.phase("n"):
+        with prof.phase("x"):
+            pass
+    table = prof.table()
+    assert "m/x" in table and "n/x" in table
+    assert "x2" not in table  # no silently merged row
+    assert prof.counts["m/x"] == 1 and prof.counts["n/x"] == 1
+    # percentages are computed over top-level spans only (children are
+    # already inside their parents' wall time)
+    assert prof.totals_by_path()["m"] >= prof.totals_by_path()["m/x"]
 
 
 def test_phase_profile_is_a_trace_with_a_tree():
@@ -295,6 +319,83 @@ def test_registry_enable_reset_and_render():
     reg.disable()
     late.inc()
     assert late.value == 1
+
+
+def test_reset_is_uniform_across_instrument_types():
+    """Satellite regression (ISSUE 8): registry.reset() delegates to each
+    instrument's own reset(), so a Counter's zero, a Gauge's zero and a
+    Histogram's empty-percentile state (count 0, percentile None,
+    exemplars cleared) can never drift apart mid-run."""
+    reg = MetricsRegistry()
+    c, g, h = reg.counter("c"), reg.gauge("g"), reg.histogram("h")
+    reg.enable()
+    c.inc(7)
+    g.set(3.5)
+    h.observe(0.25, exemplar="t1")
+    assert h.snapshot()["p50"] is not None
+    reg.reset()
+    assert c.snapshot() == {"type": "counter", "value": 0}
+    assert g.snapshot() == {"type": "gauge", "value": 0.0}
+    snap = h.snapshot()
+    assert snap["count"] == 0 and snap["sum"] == 0.0
+    assert snap["p50"] is None and snap["p95"] is None and snap["p99"] is None
+    assert "exemplars" not in snap and h.exemplars == {}
+    # instruments stay enabled across a reset (reset zeroes, not disables)
+    c.inc()
+    assert c.value == 1
+
+
+def test_quarantine_gauge_consistent_with_counters_after_midrun_reset():
+    """The concrete reset-consistency case from PR 6: the channel-
+    quarantine active gauge is derived from the entered/released counters.
+    After a mid-run registry.reset(), the gauge and both counters must
+    zero together, and the next derivation must keep gauge ==
+    max(0, entered - released) instead of going negative or stale."""
+    from automerge_tpu import sync_session as ss
+
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        ss._M_CHQ_ENTERED.inc()
+        ss._set_active_quarantined()
+        assert ss._M_CHQ_ACTIVE.value == 1
+        reg.reset()
+        # uniform reset: counters AND the derived gauge all read zero
+        assert ss._M_CHQ_ENTERED.value == 0
+        assert ss._M_CHQ_RELEASED.value == 0
+        assert ss._M_CHQ_ACTIVE.value == 0
+        # a release after the reset re-derives consistently (clamped)
+        ss._M_CHQ_RELEASED.inc()
+        ss._set_active_quarantined()
+        assert ss._M_CHQ_ACTIVE.value == 0
+        assert ss._M_CHQ_ACTIVE.value == max(
+            0, ss._M_CHQ_ENTERED.value - ss._M_CHQ_RELEASED.value
+        )
+    reg.reset()
+
+
+def test_histogram_exemplars_land_in_their_buckets():
+    """Exemplar correctness: the exemplar returned for a quantile is the
+    trace id of an observation that really landed in that quantile's
+    bucket."""
+    from automerge_tpu.obs.metrics import Histogram
+
+    h = Histogram("lat")
+    h.enabled = True
+    values = [2e-6, 5e-5, 1e-3, 0.5]  # four distinct log2 buckets
+    by_bucket = {}
+    for i, v in enumerate(values):
+        h.observe(v, exemplar=f"t{i}")
+        by_bucket[bucket_index(v)] = f"t{i}"
+    for q in (0.50, 0.95, 0.99):
+        b = h.percentile_bucket(q)
+        assert h.exemplar_for(q) == by_bucket[b]
+    # the p99 exemplar is the largest observation's trace, and that
+    # observation's value really buckets where the p99 reads from
+    assert h.exemplar_for(0.99) == "t3"
+    assert bucket_index(0.5) == h.percentile_bucket(0.99)
+    # snapshots carry the bucket -> exemplar map
+    assert h.snapshot()["exemplars"][str(bucket_index(0.5))] == "t3"
 
 
 def test_enabled_metrics_context_restores_state():

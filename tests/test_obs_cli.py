@@ -1,0 +1,118 @@
+"""Tier-1 smoke for the obs CLI contract (ISSUE 8 satellite): the
+``make obs-report`` target and the new ``--flight`` / ``--watch`` modes
+cannot rot.
+
+The Makefile target is parsed to pin that it still invokes
+``python -m automerge_tpu.obs``, and the exact same command shape is run
+as a subprocess asserting the report contract (span tree + metrics table,
+exit 0). ``--watch`` is exercised headlessly against a snapshot file
+written by a real (tiny) load-harness run — the "live top-style renderer
+against a running loadgen" satellite, in its CI-friendly one-frame form.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_cli(args, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.obs", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_makefile_obs_report_target_still_runs_the_cli():
+    """The contract `make obs-report` wires into: the target must invoke
+    `python -m automerge_tpu.obs` (the report CLI), so the smoke below
+    exercises exactly what the Make target runs."""
+    makefile = (REPO / "Makefile").read_text(encoding="utf-8")
+    target = re.search(r"^obs-report:\n(\t.+\n?)+", makefile, re.M)
+    assert target, "Makefile lost its obs-report target"
+    assert "-m automerge_tpu.obs" in target.group(0)
+
+
+def test_obs_report_subprocess_contract():
+    """The `make obs-report` command shape succeeds and prints the span
+    tree with percentiles plus the metrics table."""
+    proc = _run_cli(["--docs", "2", "--rounds", "1", "--ops", "4"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "== spans ==" in proc.stdout
+    assert "== metrics ==" in proc.stdout
+    assert "p50" in proc.stdout and "p99" in proc.stdout
+    assert "engine.device.dispatches" in proc.stdout
+
+
+def test_flight_render_needs_no_workload(tmp_path):
+    """--flight renders a dump in-process without touching jax or the
+    canned workload."""
+    from automerge_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(clock=lambda: 0.5)
+    rec.enabled = True
+    rec.record("watchdog.reset", epoch=7)
+    rec.record("flight.trigger", reason="watchdog.reset")
+    dump = tmp_path / "dump.jsonl"
+    dump.write_text(rec.to_jsonl(), encoding="utf-8")
+
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--flight", str(dump)]) == 0
+
+
+@pytest.fixture(scope="module")
+def snapshot_file(tmp_path_factory):
+    """A telemetry snapshot file produced by a real tiny load-harness run
+    (simulated time; the --watch data source)."""
+    from automerge_tpu.serve.loadgen import LoadConfig, LoadGen
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    path = tmp_path_factory.mktemp("watch") / "snaps.jsonl"
+    farm = TpuDocFarm(4, capacity=64)
+    gen = LoadGen(farm, LoadConfig(
+        clients=12, docs=4, edits_per_client=1, ops_per_edit=2,
+        spread=0.3, observability="full", snapshot_path=str(path),
+        snapshot_interval=0.2,
+    ))
+    report = gen.run()
+    assert report["converged"]
+    return path
+
+
+def test_watch_renders_latest_snapshot_headlessly(snapshot_file, capsys):
+    """The --watch satellite, exercised headlessly: one frame with the
+    tenant table, the phase shares and the flight tail, exit 0."""
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--watch", str(snapshot_file)]) == 0
+    out = capsys.readouterr().out
+    assert "phase shares" in out
+    assert "queue_wait" in out and "readback" in out and "ack" in out
+    assert "tenants" in out
+    assert "t0" in out  # a tenant row
+    assert "flight tail" in out
+
+
+def test_watch_snapshot_lines_are_self_contained(snapshot_file):
+    lines = [
+        json.loads(line)
+        for line in snapshot_file.read_text(encoding="utf-8").splitlines()
+        if line.strip()
+    ]
+    assert len(lines) >= 2  # periodic + final
+    last = lines[-1]
+    assert "metrics" in last and "tenants" in last and "flight_tail" in last
+    assert last["breakdown"]["requests"] > 0
+
+
+def test_watch_missing_file_exits_nonzero(capsys):
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--watch", "/nonexistent/snaps.jsonl"]) == 1

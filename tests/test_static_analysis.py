@@ -110,3 +110,51 @@ def test_unparseable_file_reports_am000(tmp_path):
     broken.write_text("def f(:\n")
     findings = run_analysis([broken])
     assert [f.rule_id for f in findings] == ["AM000"]
+
+
+def test_am304_reverse_direction_flags_stale_catalog_rows(tmp_path):
+    """AM304's vice-versa check: on a whole-package scan (detected by
+    obs/metrics.py being present), a README catalog row naming nothing the
+    code records is flagged, anchored on the README line."""
+    pkg = tmp_path / "automerge_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "obs" / "metrics.py").write_text(
+        '"""mini registry."""\n', encoding="utf-8"
+    )
+    (pkg / "work.py").write_text(
+        "from .obs.metrics import get_metrics\n"
+        'get_metrics().counter("mini.live.metric").inc()\n',
+        encoding="utf-8",
+    )
+    (tmp_path / "README.md").write_text(
+        "# mini\n\n### Metric catalog\n\n"
+        "| Metric | Type | Meaning |\n|---|---|---|\n"
+        "| `mini.live.metric` | counter | lives in code |\n"
+        "| `mini.stale.metric` | counter | nothing records this |\n",
+        encoding="utf-8",
+    )
+    findings = run_analysis([pkg])
+    stale = [f for f in findings if f.rule_id == "AM304"]
+    assert len(stale) == 1, [f.format() for f in findings]
+    assert "mini.stale.metric" in stale[0].message
+    assert stale[0].path.endswith("README.md")
+
+
+def test_am304_catalog_shorthand_and_placeholders_parse():
+    """The README row grammar: `.suffix` shorthand expands against the
+    previous full name, `<placeholder>` rows match dynamic registrations,
+    and only metric/event-catalog section tables participate (the amlint
+    rule-catalog table's `time.time` must NOT parse as a metric)."""
+    from automerge_tpu.analysis.catalog import catalog_names
+
+    text = (REPO_README.read_text(encoding="utf-8")
+            if REPO_README.exists() else "")
+    names = catalog_names(text)
+    assert "farm.pages.free" in names           # `.free` shorthand
+    assert "farm.quarantine.causes.<kind>" in names
+    assert "session.retransmit" in names        # event catalog included
+    assert "time.time" not in names             # rule catalog excluded
+    assert "automerge_tpu/__init__.py" not in names
+
+
+REPO_README = Path(__file__).parent.parent / "README.md"
