@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke chaos serve decode
+.PHONY: lint test native obs-report faults bench-smoke chaos serve decode mesh
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -47,6 +47,15 @@ decode:
 # `python bench.py --serve`; also a tier-1 test (tests/test_serve_smoke.py)
 serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve --quick
+
+# multi-chip mesh smoke (README "Multi-chip"): the doc-sharded MeshFarm
+# on 8 forced virtual CPU host devices — fan-out, mid-run page-granular
+# migration, actor-table reconcile convergence, ownership audit; gates
+# are machine-independent. The full MULTICHIP record run (8192 docs,
+# real devices when present): `python bench.py --mesh`; also a tier-1
+# test (tests/test_mesh_smoke.py)
+mesh:
+	$(PY) bench.py --mesh --quick
 
 native:
 	$(MAKE) -C native

@@ -38,6 +38,10 @@ Rule families (see core.RULES for the catalog):
   instead of the injectable clock/RNG the chaos suite replays (AM402);
   blocking calls (time.sleep, bare socket, synchronous device readbacks)
   inside serve/ event-loop code (AM403).
+- **AM5xx mesh**: dense per-doc ``range()`` statement loops in the mesh
+  controller's routing/merge-result paths — sparse active lists and
+  comprehensions keep per-delivery Python O(active), not O(farm)
+  (AM501).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -50,7 +54,8 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import boundary, catalog, hotpath, obsrules, packing, taxonomy, tracer
+from . import (boundary, catalog, hotpath, meshrules, obsrules, packing,
+               taxonomy, tracer)
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -83,7 +88,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
     for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
-                   hotpath):
+                   hotpath, meshrules):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

@@ -73,6 +73,10 @@ RULES: dict[str, tuple[str, str]] = {
                        "jax.device_get/block_until_ready) in serve/ "
                        "event-loop code (the loop must stay non-blocking; "
                        "justify dispatch-point suppressions)"),
+    "AM501": ("mesh", "dense per-doc `for ... in range(...)` statement loop "
+                      "in a mesh routing/merge-result path (build sparse "
+                      "active lists with comprehensions or vectorize with "
+                      "numpy)"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -40,8 +40,9 @@ from pathlib import Path
 from .core import FileContext, Finding, dotted_name
 
 #: modules implementing the profiled hot phases (gate+transcode, pack,
-#: visibility, patch_assembly)
-HOT_PHASE_STEMS = frozenset({"farm", "transcode"})
+#: visibility, patch_assembly) plus the mesh controller layer that fans
+#: deliveries across shard farms (parallel/)
+HOT_PHASE_STEMS = frozenset({"farm", "transcode", "mesh", "meshfarm"})
 
 #: modules implementing the decode hot path (AM106): the scalar codec
 #: layer and the vectorized column decode

@@ -56,7 +56,7 @@ from .core import FileContext, Finding, dotted_name
 DATA_PLANE_STEMS = frozenset({
     "codecs", "columnar", "opset", "sync", "farm", "rga",
     "sync_farm", "sync_batch", "sync_session", "transcode", "engine",
-    "text_engine", "server", "batcher", "loadgen",
+    "text_engine", "server", "batcher", "loadgen", "meshfarm",
 })
 
 _MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")

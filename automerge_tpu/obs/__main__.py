@@ -35,7 +35,7 @@ import os
 import random
 import sys
 
-from .export import request_breakdown
+from .export import request_breakdown, shard_table
 from .flight import load_jsonl, render_timeline
 from .metrics import enabled_metrics, get_metrics
 from .spans import Trace, use_trace
@@ -184,6 +184,21 @@ def _render_watch_frame(record: dict) -> str:
             )
     else:
         lines.append("(no tenant traffic)")
+    shards = shard_table(record.get("metrics", {}))
+    if shards:
+        lines.append("")
+        lines.append("-- shards --")
+        suffixes = sorted({k for row in shards.values() for k in row})
+        lines.append("  ".join([f"{'shard':>5}"] + [f"{s:>18}" for s in suffixes]))
+        for shard, row in shards.items():
+            cells = []
+            for s in suffixes:
+                v = row.get(s)
+                if isinstance(v, dict):  # histogram: count @ total ms
+                    cells.append(f"{v['count']} @ {v['sum']:.1f}ms")
+                else:
+                    cells.append("-" if v is None else str(v))
+            lines.append("  ".join([f"{shard:>5}"] + [f"{c:>18}" for c in cells]))
     lines.append("")
     lines.append("-- flight tail --")
     tail = record.get("flight_tail", [])

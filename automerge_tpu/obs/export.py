@@ -168,6 +168,38 @@ def _p99_exemplar(snap: dict):
     return buckets[str(max(lower))] if lower else buckets[sorted(buckets)[0]]
 
 
+def shard_table(metrics_snapshot: dict) -> dict:
+    """Per-shard rollup of the mesh's shard-labelled instrument families
+    (``mesh.shard.<s>.*`` and ``serve.flush.shard.<s>.docs``) from a
+    ``registry.as_dict()`` snapshot: ``{shard: {suffix: value}}``, shards
+    in ascending order. Histograms collapse to their count/sum/p99 (the
+    figures the mesh bench reports per shard); counters and gauges pass
+    their value through. The serving-side family keeps a ``flush.``
+    prefix so ``serve.flush.shard.<s>.docs`` never shadows the mesh's
+    ``mesh.shard.<s>.docs`` in the same row."""
+    import re
+
+    pattern = re.compile(r"^(mesh|serve\.flush)\.shard\.(\d+)\.(.+)$")
+    table: dict[int, dict] = {}
+    for name, snap in metrics_snapshot.items():
+        m = pattern.match(name)
+        if m is None:
+            continue
+        if snap.get("type") == "histogram":
+            cell = {
+                "count": snap.get("count", 0),
+                "sum": round(snap.get("sum", 0.0), 4),
+                "p99": snap.get("p99"),
+            }
+        else:
+            cell = snap.get("value")
+        suffix = m.group(3)
+        if m.group(1) == "serve.flush":
+            suffix = f"flush.{suffix}"
+        table.setdefault(int(m.group(2)), {})[suffix] = cell
+    return {s: table[s] for s in sorted(table)}
+
+
 def snapshot_record(t: float | None = None, registry=None, scope=None,
                     flight=None, tail: int = 16) -> dict:
     """One self-contained telemetry snapshot (a JSONL line's payload)."""

@@ -1,2 +1,6 @@
-"""Multi-chip execution: document-batch sharding over a jax.sharding.Mesh."""
-from .mesh import make_mesh, shard_batch, sharded_apply_ops, sharded_visible_state
+"""Multi-chip execution: doc-sharded shard-local farms (meshfarm.py)
+behind one controller, plus ('dp', 'sp') mesh construction (mesh.py)."""
+from .mesh import make_mesh
+from .meshfarm import MeshFarm
+
+__all__ = ["MeshFarm", "make_mesh"]

@@ -1,49 +1,48 @@
-"""Mesh construction and sharding for the batched merge engine.
+"""Mesh construction for the multi-chip merge farm.
 
 The batch-of-documents axis is embarrassingly parallel (each document's
-state is self-contained, SURVEY.md §2.5), so the primary distribution
-strategy is data parallelism over `dp`. The op-capacity axis can
-additionally be sharded over `sp` (sequence parallelism) for documents with
-very long op logs; XLA inserts the collectives needed by the sort and the
-segmented reductions across `sp` shards.
+state is self-contained, SURVEY.md §2.5), so the production distribution
+strategy is doc sharding over `dp` — meshfarm.py routes whole documents
+to shard-local farms. The op-capacity axis can additionally be sharded
+over `sp` (sequence parallelism) for documents with very long op logs;
+XLA inserts the collectives needed by the sort and the segmented
+reductions across `sp` shards.
+
+The stale dense-``BatchedDocState`` sharding helpers that predated the
+paged slab (state_sharding / changes_sharding / shard_batch /
+sharded_apply_ops / sharded_visible_state) are gone — the paged engine
+owns placement per shard farm via ``jax.default_device`` (meshfarm.py).
+``_apply_ops_impl`` stays: it is the donation-free vmapped merge step the
+compile-contract entry check exercises.
 """
 from __future__ import annotations
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..tpu.engine import BatchedDocState, ChangeOpsBatch
 
 
 def make_mesh(devices=None, sp: int = 1) -> Mesh:
-    """Builds a ('dp', 'sp') mesh over the given (or all) devices."""
+    """Builds a ('dp', 'sp') mesh over the given (or all) devices.
+
+    `sp` must divide the device count exactly — a remainder would have to
+    silently fall back to (n, 1), handing the caller a mesh with a
+    different data-parallel degree than the one their shardings assume."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if sp > 1 and n % sp == 0:
-        shape = (n // sp, sp)
-    else:
-        shape = (n, 1)
-    dev_array = np.array(devices, dtype=object).reshape(shape)
+    if sp < 1:
+        raise ValueError(f"sp must be >= 1, got {sp}")
+    if n % sp != 0:
+        raise ValueError(
+            f"sp={sp} does not divide the device count {n}: an uneven "
+            "sequence-parallel split cannot be laid out as a ('dp', 'sp') "
+            "mesh (pass an sp that divides len(devices))"
+        )
+    dev_array = np.array(devices, dtype=object).reshape((n // sp, sp))
     return Mesh(dev_array, ("dp", "sp"))
-
-
-def state_sharding(mesh: Mesh) -> BatchedDocState:
-    row = NamedSharding(mesh, P("dp", "sp"))
-    vec = NamedSharding(mesh, P("dp"))
-    return BatchedDocState(key=row, op=row, action=row, value=row,
-                           pred=row, overwritten=row, num_ops=vec)
-
-
-def changes_sharding(mesh: Mesh) -> ChangeOpsBatch:
-    row = NamedSharding(mesh, P("dp", "sp"))
-    return ChangeOpsBatch(key=row, op=row, action=row, value=row, pred=row)
-
-
-def shard_batch(tree, shardings):
-    """Places a pytree of arrays onto the mesh with the given shardings."""
-    return jax.tree.map(jax.device_put, tree, shardings)
 
 
 def _apply_ops_impl(state: BatchedDocState, changes: ChangeOpsBatch) -> BatchedDocState:
@@ -57,45 +56,3 @@ def _apply_ops_impl(state: BatchedDocState, changes: ChangeOpsBatch) -> BatchedD
         changes.key, changes.op, changes.action, changes.value, changes.pred,
     )
     return BatchedDocState(key, op, action, value, pred, over, num)
-
-
-def sharded_apply_ops(mesh: Mesh):
-    """Returns a jitted applyChanges step whose inputs/outputs are sharded
-    over the mesh: documents over `dp`, the op axis over `sp`."""
-    s_shard = state_sharding(mesh)
-    c_shard = changes_sharding(mesh)
-    return jax.jit(
-        _apply_ops_impl,
-        in_shardings=(s_shard, c_shard),
-        out_shardings=s_shard,
-    )
-
-
-def _visible_state_impl(state: BatchedDocState, cmp):
-    from ..tpu.engine import _visible_state_one_doc
-
-    return jax.vmap(_visible_state_one_doc)(
-        state.key, state.op, state.action, state.value, state.pred,
-        state.overwritten, cmp,
-    )
-
-
-def sharded_visible_state(mesh: Mesh):
-    """Returns a jitted (state, actor_rank) -> per-row visibility function.
-
-    `actor_rank` (int32[A], replicated) remaps counter-tied conflicts onto
-    lexicographic actor order, matching the engine path's tie-break
-    (engine.batched_visible_state); pass an identity table (arange) to keep
-    intern-order ties.
-    """
-    from ..tpu.engine import remap_opid_actors
-
-    s_shard = state_sharding(mesh)
-    row = NamedSharding(mesh, P("dp", "sp"))
-    rep = NamedSharding(mesh, P())
-    out = (row, row, row, row, row)
-
-    def impl(state, actor_rank):
-        return _visible_state_impl(state, remap_opid_actors(state.op, actor_rank))
-
-    return jax.jit(impl, in_shardings=(s_shard, rep), out_shardings=out)

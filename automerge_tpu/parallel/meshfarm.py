@@ -1,0 +1,447 @@
+"""MeshFarm: a doc-sharded multi-chip merge farm.
+
+One controller front over N shard-local ``TpuDocFarm``s. Each shard owns
+its documents outright — interners, page slab, host mirrors, quarantine
+set — so shards share NO mutable state and each one can live on its own
+device (``devices=[...]`` pins shard ``s``'s dispatches under
+``jax.default_device``). The controller:
+
+- **routes** every document to a shard by a stable doc-id hash
+  (splitmix64 of the global index — the placement is a pure function of
+  ``(num_docs, num_shards)``, so a restarted controller recovers the
+  same routing without any persisted table);
+- **fans out** one ``apply_changes`` delivery into per-shard
+  ``apply_changes(isolation="doc")`` sub-dispatches (only shards with
+  active docs dispatch; ``AM_MESH_CONCURRENCY`` > 1 runs them on a
+  thread pool — on real multi-chip hosts the per-shard XLA dispatches
+  overlap, on a single CPU they serialize harmlessly) and **merges** the
+  per-shard ``FarmApplyResult``s back into one global-index result;
+- **reconciles** the shard-local actor interner tables every
+  ``reconcile_interval`` applies: shards intern actors independently, so
+  a reconcile pass exchanges the table deltas (the union is interned
+  into every shard) to keep actor-rank-dependent readbacks and sync
+  filters globally consistent. Convergence is testable: a second pass
+  immediately after a first syncs zero entries;
+- **rebalances** hot/overfull documents between shards with
+  page-granular migration (``farm.export_doc`` → id translation →
+  ``engine.adopt_rows`` whole-page scatter → source ``evict_doc``),
+  driven by per-shard slab page occupancy and the controller's per-doc
+  dispatch histogram.
+
+The facade exposes the exact ``TpuDocFarm`` surface the serving stack
+consumes (``num_docs``, ``quarantine``, ``apply_changes``, ``get_*``,
+``release_quarantine``), all in GLOBAL doc indexes, so ``SyncFarm`` and
+``DynamicBatcher`` run unmodified over a mesh.
+
+Decode-cache ownership: the columnar decode caches are process-global
+and SHARED by every shard on purpose — cached entries hold actor
+*strings* and immutable op lists, never interner ids, and each shard
+interns at transcode time into its own tables. Sharing parses is safe;
+sharing interner state would not be, and there is none to share (pinned
+by tests/test_mesh_parity.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..errors import PackingLimitError
+from ..obs.flight import get_flight
+from ..obs.metrics import get_metrics
+from ..obs.scope import current_exemplar
+from ..tpu.farm import _APPLIED, FarmApplyResult, TpuDocFarm
+
+_METRICS = get_metrics()
+_M_SHARDS = _METRICS.gauge("mesh.shards", "shards in the mesh farm")
+_M_APPLY = _METRICS.counter(
+    "mesh.apply.calls", "deliveries fanned out through the mesh front"
+)
+_M_MIGRATED = _METRICS.counter(
+    "mesh.docs.migrated",
+    "documents moved between shards by page-granular migration",
+)
+_M_RECONCILE_RUNS = _METRICS.counter(
+    "mesh.reconcile.runs", "cross-shard actor-table reconcile passes"
+)
+_M_RECONCILE_SYNCED = _METRICS.counter(
+    "mesh.reconcile.actors_synced",
+    "actor table entries copied between shard interners by reconcile",
+)
+_M_REBALANCE = _METRICS.counter(
+    "mesh.rebalance.moves",
+    "documents migrated by the occupancy-driven rebalancer",
+)
+_FLIGHT = get_flight()
+
+# per-shard instrument families, registered lazily on first touch (the
+# farm.quarantine.causes.<kind> idiom): full-literal-prefix names so the
+# README catalog's <s> placeholder rows match them
+_SHARD_DISPATCH_MS: dict[int, object] = {}
+_SHARD_DOCS: dict[int, object] = {}
+
+
+def _shard_dispatch_ms(s: int):
+    h = _SHARD_DISPATCH_MS.get(s)
+    if h is None:
+        h = _METRICS.histogram(
+            f"mesh.shard.{s}.dispatch_ms",
+            f"wall time of shard {s}'s apply_changes sub-dispatches",
+        )
+        _SHARD_DISPATCH_MS[s] = h
+    return h
+
+
+def _shard_docs(s: int):
+    c = _SHARD_DOCS.get(s)
+    if c is None:
+        c = _METRICS.counter(
+            f"mesh.shard.{s}.docs",
+            f"active documents dispatched to shard {s}",
+        )
+        _SHARD_DOCS[s] = c
+    return c
+
+
+def _route(num_docs: int, num_shards: int) -> np.ndarray:
+    """Stable doc-id -> shard map: splitmix64 of the global index mod the
+    shard count. Pure and stateless — rebalancing overrides individual
+    entries at runtime, but the BASE placement needs no persisted table."""
+    x = np.arange(num_docs, dtype=np.uint64)
+    z = x + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return (z % np.uint64(num_shards)).astype(np.int64)
+
+
+class MeshFarm:
+    """N shard-local TpuDocFarms behind one controller. See module
+    docstring.
+
+    `num_shards` defaults to the visible device count when `devices` is
+    given, else 1. `spare_slots` sizes each shard's migration headroom
+    (empty doc slots a rebalance can adopt into)."""
+
+    def __init__(self, num_docs: int, num_shards: int | None = None,
+                 capacity: int = 1024, quarantine_threshold: int | None = 3,
+                 page_size: int | None = None, devices=None,
+                 reconcile_interval: int | None = 64,
+                 spare_slots: int | None = None):
+        if num_shards is None:
+            num_shards = len(devices) if devices else 1
+        if num_shards < 1 or num_docs < num_shards:
+            # amlint: disable=AM401 — API-usage validation, not a
+            # data-plane fault (nothing was decoded or dispatched)
+            raise ValueError(
+                f"need 1 <= num_shards <= num_docs, got "
+                f"num_shards={num_shards} num_docs={num_docs}"
+            )
+        self.num_docs = num_docs
+        self.num_shards = num_shards
+        self.reconcile_interval = reconcile_interval
+        self._devices = list(devices) if devices else None
+        self._shard_of = _route(num_docs, num_shards)
+        self._local_of = np.zeros(num_docs, np.int64)
+        if spare_slots is None:
+            spare_slots = max(2, (num_docs // num_shards) // 8)
+        self._owners: list[list] = []
+        self._free: list[list] = []
+        self.shards: list[TpuDocFarm] = []
+        for s in range(num_shards):
+            mine = np.nonzero(self._shard_of == s)[0]
+            self._local_of[mine] = np.arange(len(mine), dtype=np.int64)
+            self._owners.append(mine.tolist() + [None] * spare_slots)
+            self._free.append(
+                list(range(len(mine) + spare_slots - 1, len(mine) - 1, -1))
+            )
+            with self._device_ctx(s):
+                self.shards.append(TpuDocFarm(
+                    len(mine) + spare_slots, capacity=capacity,
+                    quarantine_threshold=quarantine_threshold,
+                    page_size=page_size,
+                ))
+        self._calls = 0
+        self._doc_dispatches = np.zeros(num_docs, np.int64)
+        workers = int(os.environ.get("AM_MESH_CONCURRENCY", "1"))
+        self._executor = (
+            ThreadPoolExecutor(max_workers=min(workers, num_shards))
+            if workers > 1 and num_shards > 1 else None
+        )
+        _M_SHARDS.set(num_shards)
+
+    # ------------------------------------------------------------------ #
+    # routing
+
+    def _device_ctx(self, s: int):
+        if self._devices is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self._devices[s % len(self._devices)])
+
+    def shard_of(self, d: int) -> int:
+        """Current owning shard of global doc `d` (base routing overridden
+        by migrations). The serve batcher uses this for its per-shard
+        flush accounting."""
+        return int(self._shard_of[d])
+
+    def _local(self, d: int) -> tuple[TpuDocFarm, int]:
+        s = self._shard_of[d]
+        return self.shards[s], self._local_of[d]
+
+    # ------------------------------------------------------------------ #
+    # the fan-out data plane
+
+    def apply_changes(self, per_doc_buffers, is_local: bool = False,
+                      isolation: str = "doc"):
+        """Routes one global delivery into per-shard sub-deliveries,
+        dispatches each shard's farm, and merges the per-shard results
+        into one global-index FarmApplyResult. Shards with no active docs
+        are not dispatched; their docs report the same no-op patch an
+        empty delivery produces."""
+        if isolation != "doc":
+            # amlint: disable=AM401 — API-usage validation: batch-wide
+            # rollback cannot span shard-local fault domains
+            raise ValueError(
+                "MeshFarm supports isolation='doc' only (shards are "
+                "independent fault domains)"
+            )
+        assert len(per_doc_buffers) == self.num_docs
+        self._calls += 1
+        _M_APPLY.inc()
+        shard_of, local_of = self._shard_of, self._local_of
+        active = [d for d, bufs in enumerate(per_doc_buffers) if bufs]
+        subs = [
+            [[] for _ in range(f.num_docs)] for f in self.shards
+        ]
+        for d in active:
+            subs[shard_of[d]][local_of[d]] = list(per_doc_buffers[d])
+        np.add.at(self._doc_dispatches, active, 1)
+        touched = sorted({shard_of[d] for d in active})
+        counts = {
+            s: sum(1 for d in active if shard_of[d] == s) for s in touched
+        }
+
+        def run_shard(s):
+            t0 = time.perf_counter()
+            with self._device_ctx(s):
+                result = self.shards[s].apply_changes(
+                    subs[s], is_local=is_local, isolation="doc"
+                )
+            if _METRICS.enabled:
+                _shard_dispatch_ms(s).observe(
+                    (time.perf_counter() - t0) * 1000.0,
+                    exemplar=current_exemplar(),
+                )
+                _shard_docs(s).inc(counts[s])
+            return result
+
+        results = self._dispatch_shards(touched, run_shard)
+        patches = [
+            results[shard_of[g]][local_of[g]]
+            if shard_of[g] in results
+            else self.shards[shard_of[g]]._noop_patch(local_of[g])
+            for g in range(self.num_docs)
+        ]
+        outcomes = [
+            results[shard_of[g]].outcomes[local_of[g]]
+            if shard_of[g] in results
+            else _APPLIED
+            for g in range(self.num_docs)
+        ]
+        if self.reconcile_interval and (
+            self._calls % self.reconcile_interval == 0
+        ):
+            self.reconcile_actors()
+        return FarmApplyResult(patches, outcomes)
+
+    def _dispatch_shards(self, touched, fn):
+        """Runs `fn(s)` for every touched shard; concurrently when the
+        pool is enabled (context propagated so ambient profile/scope
+        state follows each sub-dispatch), serially otherwise. Results
+        come back keyed by shard id either way."""
+        if self._executor is not None and len(touched) > 1:
+            futures = {
+                s: self._executor.submit(
+                    contextvars.copy_context().run, fn, s
+                )
+                for s in touched
+            }
+            return {s: futures[s].result() for s in touched}
+        return {s: fn(s) for s in touched}
+
+    # ------------------------------------------------------------------ #
+    # cross-shard actor reconcile
+
+    def reconcile_actors(self) -> int:
+        """Exchanges actor-table deltas between shards: the union of every
+        shard's actor strings is interned into every shard (append-only,
+        first-seen order, so the pass is deterministic). Returns the
+        number of entries copied; a converged mesh returns 0."""
+        union: list[str] = []
+        seen: set[str] = set()
+        for f in self.shards:
+            for a in f.actors.table:
+                if a not in seen:
+                    seen.add(a)
+                    union.append(a)
+        synced = 0
+        for f in self.shards:
+            missing = [a for a in union if f.actors.find(a) is None]
+            for a in missing:
+                f.actors.intern(a)
+            synced += len(missing)
+        _M_RECONCILE_RUNS.inc()
+        _M_RECONCILE_SYNCED.inc(synced)
+        if _FLIGHT.enabled:
+            _FLIGHT.record(
+                "mesh.reconcile", actors=len(union), synced=synced
+            )
+        return synced
+
+    # ------------------------------------------------------------------ #
+    # page-granular migration + the rebalancer
+
+    def migrate_doc(self, d: int, dest_shard: int) -> None:
+        """Moves global doc `d` onto `dest_shard` by whole pages: export
+        (dense page readback + host state), id translation into the
+        destination farm's interners, one adopt-scatter into freshly
+        allocated pages, then the source slot is evicted and freed."""
+        src_shard = int(self._shard_of[d])
+        if src_shard == dest_shard:
+            return
+        if not self._free[dest_shard]:
+            raise PackingLimitError(
+                f"shard {dest_shard} has no free doc slots for migration"
+            )
+        src, dst = self.shards[src_shard], self.shards[dest_shard]
+        l_src = int(self._local_of[d])
+        l_dst = self._free[dest_shard].pop()
+        export = src.export_doc(l_src)
+        with self._device_ctx(dest_shard):
+            dst.adopt_doc(l_dst, export)
+        src.evict_doc(l_src)
+        self._owners[src_shard][l_src] = None
+        self._free[src_shard].append(l_src)
+        self._owners[dest_shard][l_dst] = d
+        self._shard_of[d] = dest_shard
+        self._local_of[d] = l_dst
+        _M_MIGRATED.inc()
+        if _FLIGHT.enabled:
+            _FLIGHT.record(
+                "mesh.migrate", doc=d, src=src_shard, dest=dest_shard,
+                rows=int(export["rows"]["key"].shape[0]),
+            )
+
+    def rebalance(self, max_moves: int = 1, min_gain_pages: int = 2):
+        """Migrates the hottest doc off the most page-loaded shard onto
+        the least-loaded one, up to `max_moves` times, while the page-load
+        spread exceeds `min_gain_pages`. Heat = the controller's per-doc
+        dispatch counts, tie-broken by row count. Returns the moves as
+        (doc, src_shard, dest_shard) triples."""
+        moves = []
+        for _ in range(max_moves):
+            loads = np.fromiter(
+                (f.engine.pages.allocated for f in self.shards),
+                np.int64, count=self.num_shards,
+            )
+            src_shard = int(np.argmax(loads))
+            dest_shard = int(np.argmin(loads))
+            if (
+                src_shard == dest_shard
+                or loads[src_shard] - loads[dest_shard] < min_gain_pages
+                or not self._free[dest_shard]
+            ):
+                break
+            candidates = [
+                g for g in self._owners[src_shard] if g is not None
+            ]
+            if not candidates:
+                break
+            src = self.shards[src_shard]
+            hot = max(
+                candidates,
+                key=lambda g: (
+                    self._doc_dispatches[g],
+                    src.engine.lengths[self._local_of[g]],
+                ),
+            )
+            self.migrate_doc(hot, dest_shard)
+            moves.append((hot, src_shard, dest_shard))
+            _M_REBALANCE.inc()
+        if moves and _FLIGHT.enabled:
+            _FLIGHT.record("mesh.rebalance", moves=len(moves))
+        return moves
+
+    def audit(self) -> None:
+        """Cross-shard ownership invariants: every global doc is owned by
+        exactly one shard slot, routing arrays agree with the owner
+        tables, and free lists cover exactly the unowned slots. Raises
+        AssertionError on any leak."""
+        seen: dict[int, tuple[int, int]] = {}
+        for s, owners in enumerate(self._owners):
+            assert len(owners) == self.shards[s].num_docs
+            frees = set(self._free[s])
+            for loc, g in enumerate(owners):
+                if g is None:
+                    assert loc in frees, (s, loc)
+                    continue
+                assert loc not in frees, (s, loc)
+                assert g not in seen, f"doc {g} owned twice: {seen[g]}, {(s, loc)}"
+                seen[g] = (s, loc)
+                assert int(self._shard_of[g]) == s
+                assert int(self._local_of[g]) == loc
+        assert len(seen) == self.num_docs, "docs lost across shards"
+
+    # ------------------------------------------------------------------ #
+    # TpuDocFarm facade (global doc indexes) — the surface SyncFarm and
+    # the serve stack consume
+
+    @property
+    def quarantine(self):
+        """{global doc: last failure} across every shard."""
+        out = {}
+        for s, f in enumerate(self.shards):
+            owners = self._owners[s]
+            for loc, exc in f.quarantine.items():
+                out[owners[loc]] = exc
+        return out
+
+    def release_quarantine(self, doc: int | None = None):
+        if doc is not None:
+            f, loc = self._local(doc)
+            return [doc] if f.release_quarantine(loc) else []
+        released = []
+        for s, f in enumerate(self.shards):
+            owners = self._owners[s]
+            released.extend(owners[loc] for loc in f.release_quarantine())
+        return released
+
+    def get_patch(self, d: int):
+        f, loc = self._local(d)
+        return f.get_patch(loc)
+
+    def get_heads(self, d: int):
+        f, loc = self._local(d)
+        return f.get_heads(loc)
+
+    def get_all_changes(self, d: int):
+        f, loc = self._local(d)
+        return f.get_all_changes(loc)
+
+    def get_changes(self, d: int, have_deps):
+        f, loc = self._local(d)
+        return f.get_changes(loc, have_deps)
+
+    def get_change_by_hash(self, d: int, hash_):
+        f, loc = self._local(d)
+        return f.get_change_by_hash(loc, hash_)
+
+    def get_missing_deps(self, d: int, heads=()):
+        f, loc = self._local(d)
+        return f.get_missing_deps(loc, heads)

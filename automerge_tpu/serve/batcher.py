@@ -97,6 +97,22 @@ _M_DEFERRED = _METRICS.counter(
     "payload in this flush)",
 )
 
+# per-shard flush accounting when the farm is a MeshFarm (it exposes
+# shard_of); registered lazily per shard id, full-literal-prefix names so
+# the README catalog's <s> placeholder row matches
+_SHARD_FLUSH_DOCS: dict[int, object] = {}
+
+
+def _shard_flush_docs(s: int):
+    c = _SHARD_FLUSH_DOCS.get(s)
+    if c is None:
+        c = _METRICS.counter(
+            f"serve.flush.shard.{s}.docs",
+            f"flushed change-carrying docs routed to mesh shard {s}",
+        )
+        _SHARD_FLUSH_DOCS[s] = c
+    return c
+
 
 @dataclass
 class BatcherConfig:
@@ -364,6 +380,13 @@ class DynamicBatcher:
                 _M_DISPATCHES.inc()
                 _M_OCCUPANCY.observe(len(change_docs))
                 _M_CHANGES.inc(report.changes_applied)
+                shard_of = getattr(self.farm, "shard_of", None)
+                if shard_of is not None and _METRICS.enabled:
+                    # mesh-backed serving: label the flush's doc fan-out
+                    # by owning shard (the sub-dispatch concurrency lives
+                    # inside MeshFarm.apply_changes)
+                    for doc in change_docs:
+                        _shard_flush_docs(shard_of(doc)).inc()
             if span is not None:
                 phases = {
                     path: entry["total_s"]

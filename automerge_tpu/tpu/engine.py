@@ -386,6 +386,7 @@ from .paging import (  # noqa: E402
     PageAllocator,
     grow_slab,
     make_empty_slab,
+    paged_adopt_rows,
     paged_apply_ops,
     paged_dense_view,
     paged_probe_ops,
@@ -645,6 +646,63 @@ class BatchedMapEngine:
         self.pages.free([p for p in self.page_table[d] if p not in keep])
         self.page_table[d] = list(pages)
         self.lengths[d] = int(length)
+        self._update_page_metrics()
+
+    def adopt_rows(self, d: int, key, op, action, value, pred, over) -> None:
+        """Installs a migrated document's op rows as doc `d`'s pages (the
+        destination half of cross-farm page-granular migration). Doc `d`
+        must be empty; rows arrive as host arrays already translated into
+        THIS engine's id space and sorted by merge key. Pages are
+        allocated fresh and written by one whole-page scatter program —
+        host padding keeps the page-tail invariant."""
+        assert not self.page_table[d], "adopt_rows into an occupied doc"
+        n = int(np.asarray(key).shape[0])
+        self.lengths[d] = n
+        self.version += 1
+        self._vis_memo.clear()
+        if n == 0:
+            self._update_page_metrics()
+            return
+        P = self.pages.page_size
+        npg = self.pages.pages_for(n)
+        if self.pages.ensure(npg):
+            self.slab = grow_slab(self.slab, self.pages.num_pages * P)
+            _M_STATE_GROWS.inc()
+        pages = self.pages.alloc(npg)
+        npg_pad = self._pow2(npg)
+        dest = np.full(npg_pad, self.pages.num_pages, np.int32)
+        dest[:npg] = pages
+        w = npg_pad * P
+
+        def pad(col, fill, dtype):
+            out = np.full(w, fill, dtype)
+            out[:n] = col
+            return out
+
+        self.slab = _dispatch(
+            paged_adopt_rows, self.slab, jnp.asarray(dest),
+            jnp.asarray(pad(key, PAD_KEY, np.int32)),
+            jnp.asarray(pad(op, 0, np.int64)),
+            jnp.asarray(pad(action, 0, np.int32)),
+            jnp.asarray(pad(value, 0, np.int64)),
+            jnp.asarray(pad(pred, -1, np.int64)),
+            jnp.asarray(pad(over, False, np.bool_)),
+            page_size=P,
+        )
+        self.page_table[d] = pages
+        self._update_page_metrics()
+
+    def evict_doc(self, d: int) -> None:
+        """Releases doc `d`'s pages to the free list and zeroes its length
+        (the source half of migration). No device rows are wiped: freed
+        pages are fully overwritten at their next allocation — every
+        scatter (paged_apply_ops / paged_adopt_rows) writes whole pages,
+        the same reasoning that lets restore_doc return pages untouched."""
+        self.pages.free(self.page_table[d])
+        self.page_table[d] = []
+        self.lengths[d] = 0
+        self.version += 1
+        self._vis_memo.clear()
         self._update_page_metrics()
 
     def _update_page_metrics(self) -> None:

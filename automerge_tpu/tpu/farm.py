@@ -111,6 +111,21 @@ class ChildObj(NamedTuple):
 
 _ROOT_META = {"parentObj": None, "parentKey": None, "type": "map"}
 
+
+def _remap_packed(col, amap):
+    """Rewrites the actor field of a packed-opid column through `amap`
+    (source actor id -> destination actor id); -1 sentinels pass through.
+    The counter field is actor-independent and survives unchanged."""
+    out = np.asarray(col, np.int64).copy()
+    live = out >= 0
+    ops = out[live]
+    out[live] = (ops & ~np.int64(ACTOR_MASK)) | amap[ops & np.int64(ACTOR_MASK)]
+    return out
+
+
+def _remap_packed_one(packed: int, amap) -> int:
+    return int((packed & ~ACTOR_MASK) | int(amap[packed & ACTOR_MASK]))
+
 # farm metrics (process-wide registry, disabled unless a workload opts in —
 # obs/metrics.py). All recording is host-side, outside the device phases.
 _METRICS = get_metrics()
@@ -1323,6 +1338,234 @@ class TpuDocFarm:
         if released and _FLIGHT.enabled:
             _FLIGHT.record("farm.quarantine.release", docs=released)
         return released
+
+    # ------------------------------------------------------------------ #
+    # cross-farm migration (parallel/meshfarm.py): a document moves between
+    # farms as whole pages. Interner id spaces are farm-local, so the
+    # export carries the source tables and adopt translates every id —
+    # actors by whole-table remap (the same union a reconcile pass
+    # produces), slots/values only where the doc references them (their
+    # tables are packing ranges / unbounded payload tables that must not
+    # import other docs' entries).
+
+    def export_doc(self, d: int) -> dict:
+        """Self-contained snapshot of doc `d` for migration to another
+        farm. Row columns and packed-opid host state are in THIS farm's id
+        space; the interner tables ride along by reference (they are
+        append-only and the importer only reads them). Mutable host
+        containers are copied, so the export stays valid after
+        ``evict_doc``."""
+        keys, ops, actions, values, preds, overs = self.engine.dense_view([d])
+        n = int(self.engine.lengths[d])
+        return {
+            "rows": {
+                "key": np.asarray(keys[0][:n], np.int64),
+                "op": np.asarray(ops[0][:n], np.int64),
+                "action": np.asarray(actions[0][:n], np.int64),
+                "value": np.asarray(values[0][:n], np.int64),
+                "pred": np.asarray(preds[0][:n], np.int64),
+                "overwritten": np.asarray(overs[0][:n], bool),
+            },
+            "actor_table": list(self.actors.table),
+            "slot_table": list(self.slots.table),
+            "value_table": list(self.values.table),
+            "object_meta": dict(self.object_meta[d]),
+            "clock": dict(self.clock[d]),
+            "heads": list(self.heads[d]),
+            "queue": list(self.queue[d]),
+            "changes": list(self.changes[d]),
+            "change_index": dict(self.change_index_by_hash[d]),
+            "hashes_by_actor": {
+                k: list(v) for k, v in self.hashes_by_actor[d].items()
+            },
+            "deps_by_hash": {
+                k: list(v) for k, v in self.dependencies_by_hash[d].items()
+            },
+            "dependents": {
+                k: list(v) for k, v in self.dependents_by_hash[d].items()
+            },
+            "max_op": self.max_op[d],
+            "counter_ops": set(self.counter_ops[d]),
+            "inc_max": dict(self.inc_max[d]),
+            "starved": set(self.starved[d]),
+            # children re-keyed symbolically: slot ids are farm-local but
+            # the interned (objectId, key) tuples are globally meaningful
+            "children": {
+                self.slots.lookup(s): dict(v)
+                for s, v in self.children[d].items()
+            },
+            "num_elems": int(self.num_elems[d]),
+            "elem_opid": self.elem_opid[d, : int(self.num_elems[d])].copy(),
+            "elem_parent": self.elem_parent[d, : int(self.num_elems[d])].copy(),
+            "elem_index": dict(self.elem_index[d]),
+            "elem_ids": list(self.elem_ids[d]),
+            "elem_object": list(self.elem_object[d]),
+            "exact": self.exact[d],
+            "fault_count": self.fault_counts[d],
+            "quarantine": self.quarantine.get(d),
+            "degraded": d in self.degraded,
+        }
+
+    def adopt_doc(self, d: int, export: dict) -> None:
+        """Installs an exported document as doc `d` (which must be empty):
+        translates every interner id into this farm's tables, re-sorts the
+        rows by the destination merge key (stable, so multi-pred marker
+        rows keep sorting directly after their primary), scatters them
+        into freshly allocated pages, and rebuilds the host mirror. The
+        visible/total cache starts stale and refreshes on the next read."""
+        assert not self.changes[d] and not self.engine.page_table[d], (
+            "adopt_doc target must be an empty doc slot"
+        )
+        rows = export["rows"]
+        n = int(rows["key"].shape[0])
+        src_actors = export["actor_table"]
+        amap = np.fromiter(
+            (self.actors.intern(a) for a in src_actors),
+            np.int64, count=len(src_actors),
+        )
+        slot_table = export["slot_table"]
+        used_s = np.unique(rows["key"]) if n else np.zeros(0, np.int64)
+        smap = np.zeros(
+            int(used_s.max()) + 1 if used_s.size else 1, np.int64
+        )
+        smap[used_s] = np.fromiter(
+            (self.slots.intern(slot_table[s]) for s in used_s.tolist()),
+            np.int64, count=used_s.size,
+        )
+        # value ids live only in non-counter SET primaries — markers carry
+        # zero, counter SET/INC rows carry raw integers (see _op_rows)
+        value_table = export["value_table"]
+        op_col = np.asarray(rows["op"], np.int64)
+        action = np.asarray(rows["action"], np.int64)
+        ctr_ops = export["counter_ops"]
+        if ctr_ops and n:
+            is_ctr = np.isin(
+                op_col, np.fromiter(ctr_ops, np.int64, count=len(ctr_ops))
+            )
+        else:
+            is_ctr = np.zeros(n, bool)
+        val_mask = (action == ACTION_SET) & ~is_ctr
+        value = np.asarray(rows["value"], np.int64).copy()
+        used_v = (
+            np.unique(value[val_mask]) if val_mask.any()
+            else np.zeros(0, np.int64)
+        )
+        vmap = np.zeros(
+            int(used_v.max()) + 1 if used_v.size else 1, np.int64
+        )
+        for v in used_v.tolist():
+            cell = value_table[v]
+            nid = self.values.intern(cell)
+            if isinstance(cell, ChildObj):
+                self._child_value_ids.add(nid)
+            vmap[v] = nid
+        value[val_mask] = vmap[value[val_mask]]
+        key = smap[np.asarray(rows["key"], np.int64)]
+        op = _remap_packed(op_col, amap)
+        pred = _remap_packed(np.asarray(rows["pred"], np.int64), amap)
+        over = np.asarray(rows["overwritten"], bool)
+        mkey = (key << _MKEY_OP_BITS) | op
+        order = np.argsort(mkey, kind="stable")
+        self.engine.adopt_rows(
+            d, key[order].astype(np.int32), op[order],
+            action[order].astype(np.int32), value[order], pred[order],
+            over[order],
+        )
+        # symbolic host state moves as-is; packed-opid fields ride the
+        # actor remap; children re-key to this farm's slot ids
+        self.object_meta[d] = export["object_meta"]
+        self.clock[d] = export["clock"]
+        self.heads[d] = export["heads"]
+        self.queue[d] = export["queue"]
+        self.changes[d] = export["changes"]
+        self.change_index_by_hash[d] = export["change_index"]
+        self.hashes_by_actor[d] = export["hashes_by_actor"]
+        self.dependencies_by_hash[d] = export["deps_by_hash"]
+        self.dependents_by_hash[d] = export["dependents"]
+        self.max_op[d] = export["max_op"]
+        if ctr_ops:
+            ctr_arr = _remap_packed(
+                np.fromiter(ctr_ops, np.int64, count=len(ctr_ops)), amap
+            )
+            self.counter_ops[d] = set(ctr_arr.tolist())
+        else:
+            self.counter_ops[d] = set()
+        self.inc_max[d] = {
+            _remap_packed_one(k, amap): v
+            for k, v in export["inc_max"].items()
+        }
+        self.starved[d] = {
+            _remap_packed_one(k, amap) for k in export["starved"]
+        }
+        self.children[d] = {
+            self.slots.intern(sk): dict(v)
+            for sk, v in export["children"].items()
+        }
+        ne = export["num_elems"]
+        self._grow_elems(ne)
+        self.num_elems[d] = ne
+        self.elem_opid[d, :ne] = _remap_packed(export["elem_opid"], amap)
+        self.elem_parent[d, :ne] = export["elem_parent"]
+        self.elem_index[d] = export["elem_index"]
+        self.elem_ids[d] = export["elem_ids"]
+        self.elem_object[d] = export["elem_object"]
+        self.exact[d] = export["exact"]
+        self.fault_counts[d] = export["fault_count"]
+        if export["quarantine"] is not None:
+            self.quarantine[d] = export["quarantine"]
+        else:
+            self.quarantine.pop(d, None)
+        if export["degraded"]:
+            self.degraded.add(d)
+        else:
+            self.degraded.discard(d)
+        # host mirror: static columns from the translated rows, the
+        # visible/total cache conservatively marked whole-doc stale
+        self._vis_mkey[d] = mkey[order]
+        self._vis_key[d] = key[order].astype(np.int32)
+        self._vis_op[d] = op[order]
+        self._vis_action[d] = action[order].astype(np.int32)
+        self._vis_visible[d] = np.zeros(n, bool)
+        self._vis_total[d] = np.zeros(n, np.int64)
+        self._vis_all_stale[d] = bool(n)
+        self._vis_stale[d] = set()
+
+    def evict_doc(self, d: int) -> None:
+        """Resets doc `d` to the fresh-document state and returns its slab
+        pages to the allocator (the source half of migration; the export
+        was taken first). Interner entries stay — they are append-only
+        shared lookup tables, never document state."""
+        self.engine.evict_doc(d)
+        self.object_meta[d] = {"_root": dict(_ROOT_META)}
+        self.clock[d] = {}
+        self.heads[d] = []
+        self.queue[d] = []
+        self.changes[d] = []
+        self.change_index_by_hash[d] = {}
+        self.hashes_by_actor[d] = {}
+        self.dependencies_by_hash[d] = {}
+        self.dependents_by_hash[d] = {}
+        self.max_op[d] = 0
+        self.counter_ops[d] = set()
+        self.inc_max[d] = {}
+        self.starved[d] = set()
+        self.children[d] = {}
+        self.num_elems[d] = 0
+        self.elem_index[d] = {}
+        self.elem_ids[d] = []
+        self.elem_object[d] = []
+        self.exact[d] = None
+        self.fault_counts[d] = 0
+        self.quarantine.pop(d, None)
+        self.degraded.discard(d)
+        self._vis_mkey[d] = np.empty(0, np.int64)
+        self._vis_key[d] = np.empty(0, np.int32)
+        self._vis_op[d] = np.empty(0, np.int64)
+        self._vis_action[d] = np.empty(0, np.int32)
+        self._vis_visible[d] = np.empty(0, bool)
+        self._vis_total[d] = np.empty(0, np.int64)
+        self._vis_stale[d] = set()
+        self._vis_all_stale[d] = False
 
     # ------------------------------------------------------------------ #
     # incremental visibility: host row mirror + scoped device readback

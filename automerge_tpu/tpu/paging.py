@@ -227,3 +227,28 @@ def paged_visible_ranked(slab: SlabState, gather_pages, actor_rank, *,
 def paged_dense_view(slab: SlabState, gather_pages, *, page_size: int):
     """Dense [D, W] gather of all six columns (parity/debug readback)."""
     return _gather_pages(slab, gather_pages, page_size)
+
+
+@partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0,))
+def paged_adopt_rows(slab: SlabState, dest_pages, key, op, action, value,
+                     pred, over, *, page_size: int) -> SlabState:
+    """Installs externally prepared rows (a migrated document) into freshly
+    allocated pages: a pure whole-page scatter, no merge. The row columns
+    arrive host-padded to ``len(dest_pages) * page_size`` with PAD fills,
+    so every written page keeps the page-tail invariant; `dest_pages` holds
+    ``num_pages`` (out of range -> dropped) for pow2-bucket pad slots."""
+
+    def scatter(col, vals):
+        paged = col.reshape(-1, page_size)
+        return paged.at[dest_pages].set(
+            vals.reshape(-1, page_size), mode="drop"
+        ).reshape(-1)
+
+    return SlabState(
+        key=scatter(slab.key, key),
+        op=scatter(slab.op, op),
+        action=scatter(slab.action, action),
+        value=scatter(slab.value, value),
+        pred=scatter(slab.pred, pred),
+        overwritten=scatter(slab.overwritten, over),
+    )

@@ -1,0 +1,16 @@
+# amlint: mesh-routing — fixture: sparse active lists stay clean
+
+
+def route(per_doc_buffers, shard_of, local_of, subs):
+    """The blessed controller shape: comprehension-built sparse active
+    list, statement loop only over docs that actually carry buffers."""
+    active = [d for d, bufs in enumerate(per_doc_buffers) if bufs]
+    for d in active:
+        subs[shard_of[d]][local_of[d]] = per_doc_buffers[d]
+    return subs
+
+
+def merge(results, shard_of, local_of, num_docs):
+    """Whole-batch transforms are comprehensions: one pass, no
+    per-iteration statement overhead."""
+    return [results[shard_of[g]][local_of[g]] for g in range(num_docs)]

@@ -1,0 +1,16 @@
+# amlint: mesh-routing — fixture: dense per-doc routing loop (AM501)
+
+
+def route(per_doc_buffers, shard_of, local_of, subs):
+    """The O(farm) controller shape: a statement loop scanning every doc
+    slot on every delivery, active or not."""
+    for d in range(len(per_doc_buffers)):
+        subs[shard_of[d]][local_of[d]] = per_doc_buffers[d]
+    return subs
+
+
+def merge(results, shard_of, local_of, num_docs):
+    patches = []
+    for g in range(num_docs):
+        patches.append(results[shard_of[g]][local_of[g]])
+    return patches

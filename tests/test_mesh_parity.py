@@ -1,0 +1,244 @@
+"""Mesh parity suite (ISSUE 10): the doc-sharded MeshFarm must be
+OBSERVATIONALLY IDENTICAL to a single TpuDocFarm — byte-for-byte patch
+parity (canonical JSON, stricter than dict equality) across the fuzz
+corpus, across quarantine/rollback interleavings from the byte-fault
+corpus, across mid-delivery page-granular migrations, and with the
+periodic actor-table reconcile running mid-workload. The decode-cache
+ownership audit rides along: the process-global decode LRUs are shared
+by every shard on purpose (they hold actor strings and immutable op
+lists, never interner ids), so shards with divergent interner states
+must decode a fanned-out buffer once and still produce identical
+patches.
+"""
+import json
+
+import pytest
+
+from automerge_tpu.opset import OpSet
+from automerge_tpu.parallel import MeshFarm
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+from test_farm import Workload
+
+SEEDS = [11, 23, 47]
+ROUNDS = 10
+NUM_DOCS = 8
+NUM_SHARDS = 3
+
+
+def canon(patch):
+    return json.dumps(patch, sort_keys=True)
+
+
+def assert_patch_equal(got, want, context=""):
+    assert canon(got) == canon(want), (
+        f"{context}: mesh patch diverged from the single farm\n"
+        f"got:  {canon(got)}\nwant: {canon(want)}"
+    )
+
+
+def run_pair(seed, num_docs=NUM_DOCS, num_shards=NUM_SHARDS, rounds=ROUNDS,
+             deliver=None, between_rounds=None, quarantine_threshold=None,
+             reconcile_interval=None):
+    """Drives one random workload through a MeshFarm and a single
+    TpuDocFarm side by side, asserting per-call outcome + patch parity.
+    `deliver` rewrites deliveries (fault interleavings); `between_rounds`
+    runs controller actions (migration) mid-stream."""
+    mesh = MeshFarm(num_docs, num_shards=num_shards, capacity=64,
+                    quarantine_threshold=quarantine_threshold,
+                    reconcile_interval=reconcile_interval)
+    solo = TpuDocFarm(num_docs, capacity=64,
+                      quarantine_threshold=quarantine_threshold)
+    gen = OpSet()
+    workload = Workload(seed)
+    for r in range(rounds):
+        buffers = workload.next_round(gen)
+        if buffers:
+            per_doc = [list(buffers) for _ in range(num_docs)]
+            if deliver is not None:
+                per_doc = deliver(r, per_doc)
+            got = mesh.apply_changes(per_doc)
+            want = solo.apply_changes(per_doc)
+            for d in range(num_docs):
+                assert got.outcomes[d].status == want.outcomes[d].status, (
+                    f"seed={seed} round={r} doc={d}: outcome diverged "
+                    f"({got.outcomes[d]} vs {want.outcomes[d]})"
+                )
+                assert_patch_equal(
+                    got[d], want[d], f"seed={seed} round={r} doc={d}"
+                )
+            gen.apply_changes(list(buffers))
+        if between_rounds is not None:
+            between_rounds(r, mesh)
+    for d in range(num_docs):
+        assert_patch_equal(
+            mesh.get_patch(d), solo.get_patch(d),
+            f"seed={seed} whole-doc doc={d}",
+        )
+    return mesh, solo
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_corpus_mesh_matches_single_farm(seed):
+    """Random map-family workloads (concurrent actors, counters, nesting,
+    deletes, delayed delivery) land byte-identically whether the docs
+    live in one farm or are hash-routed across three shard farms."""
+    run_pair(seed)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_mid_delivery_migration_keeps_parity(seed):
+    """A doc migrated between shards mid-workload (snapshot -> page-table
+    transplant -> release) keeps merging the remaining rounds with
+    byte-identical patches: the id translation into the destination
+    interners must be lossless."""
+    moved = []
+
+    def between_rounds(r, mesh):
+        if r == rounds_split:
+            src = mesh.shard_of(doc)
+            dest = (src + 1) % mesh.num_shards
+            mesh.migrate_doc(doc, dest)
+            assert mesh.shard_of(doc) == dest != src
+            mesh.audit()
+            moved.append((src, dest))
+
+    doc, rounds_split = 2, 4
+    run_pair(seed, between_rounds=between_rounds)
+    assert moved, "the migration round never ran"
+
+
+@pytest.mark.parametrize("name,corrupt,kind", faults.BYTE_CORPUS)
+def test_quarantine_rollback_parity(name, corrupt, kind):
+    """A poisoned delivery must quarantine the same doc in the same round
+    on both sides, roll its state back identically, and leave every
+    later clean delivery byte-identical."""
+    poison_round, poison_doc = 3, 1
+
+    def deliver(r, per_doc):
+        if r == poison_round and per_doc[poison_doc]:
+            per_doc[poison_doc] = [
+                bytes(corrupt(buf)) for buf in per_doc[poison_doc]
+            ]
+        return per_doc
+
+    run_pair(7, deliver=deliver)
+
+
+def test_quarantined_doc_migrates_with_its_quarantine():
+    """Migration must carry the quarantine entry: a shed doc stays shed
+    on its new shard, release (run on BOTH farms at the same round
+    boundary) returns it to service there, and everything stays
+    byte-identical to the single farm through the whole interleaving."""
+    poison_doc = 1
+    corrupt = faults.BYTE_CORPUS[1][1]  # bit_flipped
+    mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                    quarantine_threshold=1)
+    solo = TpuDocFarm(NUM_DOCS, capacity=64, quarantine_threshold=1)
+    gen = OpSet()
+    workload = Workload(7)
+    # poison the first non-empty round >= 2, migrate two non-empty rounds
+    # later, release two after that (Workload rounds can be empty)
+    stage, stage_round = 0, 0
+    for r in range(ROUNDS + 4):
+        buffers = workload.next_round(gen)
+        if not buffers:
+            continue
+        stage_round += 1
+        per_doc = [list(buffers) for _ in range(NUM_DOCS)]
+        if stage == 0 and stage_round >= 2:
+            per_doc[poison_doc] = [
+                bytes(corrupt(buf)) for buf in per_doc[poison_doc]
+            ]
+            stage, stage_round = 1, 0
+        got = mesh.apply_changes(per_doc)
+        want = solo.apply_changes(per_doc)
+        for d in range(NUM_DOCS):
+            assert got.outcomes[d].status == want.outcomes[d].status, (
+                f"round={r} doc={d}: {got.outcomes[d]} vs {want.outcomes[d]}"
+            )
+            assert_patch_equal(got[d], want[d], f"round={r} doc={d}")
+        gen.apply_changes(list(buffers))
+        if stage == 1 and stage_round >= 2:
+            assert poison_doc in mesh.quarantine
+            assert poison_doc in solo.quarantine
+            dest = (mesh.shard_of(poison_doc) + 1) % mesh.num_shards
+            mesh.migrate_doc(poison_doc, dest)
+            assert mesh.shard_of(poison_doc) == dest
+            assert poison_doc in mesh.quarantine, (
+                "quarantine entry lost in migration"
+            )
+            mesh.audit()
+            stage, stage_round = 2, 0
+        elif stage == 2 and stage_round >= 2:
+            assert mesh.release_quarantine(doc=poison_doc) == [poison_doc]
+            solo.release_quarantine(poison_doc)
+            assert poison_doc not in mesh.quarantine
+            stage, stage_round = 3, 0
+    assert stage == 3, f"interleaving never completed (stage={stage})"
+    for d in range(NUM_DOCS):
+        assert_patch_equal(
+            mesh.get_patch(d), solo.get_patch(d), f"whole-doc doc={d}"
+        )
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_reconcile_during_workload_keeps_parity(seed):
+    """With reconcile_interval=2 the actor-table reconcile runs every
+    other apply — interning foreign actors into every shard mid-stream
+    must never change any patch, and the tables converge (a second
+    explicit pass syncs zero)."""
+    mesh, _ = run_pair(seed, reconcile_interval=2)
+    mesh.reconcile_actors()
+    assert mesh.reconcile_actors() == 0
+
+
+def test_decode_cache_shared_across_shards_without_state():
+    """The ownership audit pinned as a regression test: shards share the
+    process-global decode caches (parses), never interner state. Two
+    shards whose interner tables have DIVERGED (different private actors
+    interned first) decode one fanned-out buffer once, intern its actor
+    at different indices, and still emit byte-identical patches."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+
+    mesh = MeshFarm(6, num_shards=2, capacity=32, quarantine_threshold=None)
+    by_shard = {}
+    for d in range(6):
+        by_shard.setdefault(mesh.shard_of(d), []).append(d)
+    assert len(by_shard) == 2, "routing degenerated to one shard"
+    (s0, docs0), (s1, docs1) = sorted(by_shard.items())
+
+    # diverge the shard interners in CONTENT and SIZE: two private actors
+    # delivered to one shard only, a different single one to the other
+    priv0a = faults.make_change("dd" * 4, 1, 1, [], [faults.set_op("p", 1)])
+    priv0b = faults.make_change("cc" * 4, 1, 1, [], [faults.set_op("q", 3)])
+    priv1 = faults.make_change("ee" * 4, 1, 1, [], [faults.set_op("p", 2)])
+    delivery = [[] for _ in range(6)]
+    delivery[docs0[0]] = [priv0a, priv0b]
+    delivery[docs1[0]] = [priv1]
+    mesh.apply_changes(delivery)
+    f0, f1 = mesh.shards[s0], mesh.shards[s1]
+    assert f0.actors.find("dd" * 4) is not None
+    assert f1.actors.find("dd" * 4) is None  # tables have genuinely diverged
+
+    # fan ONE buffer to every doc on both shards, decode-counted
+    shared = faults.make_change("ff" * 4, 1, 1, [], [faults.set_op("x", 9)])
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        result = mesh.apply_changes([[shared]] * 6)
+    misses = reg.counter("codecs.decode_cache.misses").value
+    hits = reg.counter("codecs.decode_cache.hits").value
+    assert misses <= 1, "shards must share the decode parse, not re-miss"
+    assert hits >= 5 - misses
+    # the shared actor landed at DIFFERENT interner indices per shard
+    # (each table already held a different private actor) ...
+    assert f0.actors.find("ff" * 4) != f1.actors.find("ff" * 4)
+    # ... and the cached entry was not mutated by either shard's intern:
+    # the patches are identical across shards for the identical stream
+    assert canon(result[docs0[1]]) == canon(result[docs1[1]])
+    oracle = OpSet()
+    want = oracle.apply_changes([shared])
+    for d in (docs0[1], docs1[1]):
+        assert_patch_equal(result[d], want, f"shared-buffer doc={d}")

@@ -100,6 +100,39 @@ def test_watch_renders_latest_snapshot_headlessly(snapshot_file, capsys):
     assert "flight tail" in out
 
 
+def test_watch_renders_mesh_shard_table(tmp_path, capsys):
+    """Shard-labelled mesh metrics in a snapshot pivot into the per-shard
+    --watch section via ``obs.export.shard_table`` (one row per shard,
+    histograms collapsed to count @ total ms)."""
+    from automerge_tpu.obs.__main__ import main
+
+    record = {
+        "t": 1.0,
+        "metrics": {
+            "mesh.shard.0.docs": {"type": "counter", "value": 96},
+            "mesh.shard.1.docs": {"type": "counter", "value": 160},
+            "mesh.shard.0.dispatch_ms": {
+                "type": "histogram", "count": 2, "sum": 12.5, "p99": 8.0,
+            },
+            "serve.flush.shard.1.docs": {"type": "counter", "value": 7},
+            "mesh.shards": {"type": "gauge", "value": 2},  # unlabelled: not a row
+        },
+        "tenants": {},
+        "flight_tail": [],
+    }
+    path = tmp_path / "snaps.jsonl"
+    path.write_text(json.dumps(record) + "\n", encoding="utf-8")
+    assert main(["--watch", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- shards --" in out
+    assert "dispatch_ms" in out and "docs" in out
+    assert "flush.docs" in out  # serve family must not shadow mesh docs
+    assert "96" in out and "160" in out
+    assert "2 @ 12.5ms" in out  # the histogram cell
+    rows = [ln for ln in out.splitlines() if ln.strip().startswith(("0 ", "1 "))]
+    assert len(rows) == 2
+
+
 def test_watch_snapshot_lines_are_self_contained(snapshot_file):
     lines = [
         json.loads(line)
