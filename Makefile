@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke chaos serve decode mesh
+.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -30,6 +30,13 @@ chaos:
 # "Performance"); also runs as a tier-1 test (tests/test_bench_smoke.py)
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --quick
+
+# gate-phase microbench: the same delivery stream through the columnar
+# causal gate and the scalar oracle chain (gate_mode="oracle"); gates on
+# canonical patch parity and the columnar gate phases beating the scalar
+# chain (README "Performance")
+gate-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --gate
 
 # columnar decode microbench (cold/warm MB/s, scalar vs vectorized vs
 # native) + mixed-size page-packing report; gates on the vectorized path

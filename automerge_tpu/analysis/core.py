@@ -44,6 +44,10 @@ RULES: dict[str, tuple[str, str]] = {
     "AM106": ("hotpath", "per-byte Python decode loop in a decode hot-path "
                          "module (vectorize: continuation-bit mask + "
                          "prefix scan, record-level run expansion)"),
+    "AM107": ("hotpath", "per-change/per-op Python loop in a gate/transcode "
+                         "hot path (compute gate verdicts and op columns "
+                         "with batched column programs; scalar-oracle "
+                         "loops carry justified suppressions)"),
     "AM201": ("tracer", "Python-level control flow on a traced value inside "
                         "jit/pallas-traced code"),
     "AM202": ("tracer", "host-side call (np.*, int()/float(), .item()) on a "

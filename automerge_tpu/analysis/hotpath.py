@@ -1,5 +1,6 @@
-"""AM105/AM106 — hot-phase hygiene: no per-row Python in the farm's
-profiled hot phases, no per-byte Python in the decode hot path.
+"""AM105/AM106/AM107 — hot-phase hygiene: no per-row Python in the farm's
+profiled hot phases, no per-byte Python in the decode hot path, no
+per-change/per-op Python in the gate/transcode hot paths.
 
 BENCH_r05 showed the merge farm spending >85% of wall time in host-side
 Python that re-walks state row by row (``visibility`` + ``patch_assembly``
@@ -31,6 +32,18 @@ must not creep back into decode modules. Scope: filename stems in
 ``DECODE_STEMS`` plus hot-path-marked files; the scalar parity oracle
 (codecs.py) keeps its byte loops under justified suppressions — it IS
 the reference the vector passes are tested against.
+
+AM107 bans the shape the columnar causal gate replaced (BENCH_r07): a
+``for`` STATEMENT in a hot-phase module that walks deliveries
+change-by-change or ops op-by-op — a loop target named ``change``/``op``,
+or iteration over a pending/applied/decoded collection, or over a
+change's ``["ops"]`` list. Gate verdicts come from dep-index columns
+(transcode.gate_verdicts) and op rows from cached column blocks; per-
+change Python belongs only on the scalar oracle chain, whose loops carry
+justified suppressions (it owns the canonical result/error for re-routed
+anomalies). Comprehensions are deliberately exempt: sparse bookkeeping
+builds (plan lists, per-doc dict updates) are not the quadratic shape
+this rule hunts.
 """
 from __future__ import annotations
 
@@ -130,6 +143,51 @@ def _is_cursor_step(node: ast.AugAssign) -> bool:
     )
 
 
+#: loop targets that name a per-change / per-op walk
+_CHANGE_TARGETS = frozenset({"change", "op"})
+
+#: iterables holding the delivery's change stream
+_CHANGE_ITERS = frozenset({"pending", "applied", "decoded", "applied_ops"})
+
+
+def _is_change_loop(node: ast.For) -> bool:
+    """``for`` statements that walk changes or ops one at a time: the
+    target is named ``change``/``op`` (possibly inside a tuple unpack),
+    the iterable is a pending/applied/decoded collection, or the
+    iterable is someone's ``["ops"]`` list."""
+    target = node.target
+    names = []
+    if isinstance(target, ast.Name):
+        names = [target.id]
+    elif isinstance(target, ast.Tuple):
+        names = [e.id for e in target.elts if isinstance(e, ast.Name)]
+    if any(n in _CHANGE_TARGETS for n in names):
+        return True
+    it = node.iter
+    if isinstance(it, ast.Name) and it.id in _CHANGE_ITERS:
+        return True
+    if isinstance(it, ast.Subscript):
+        sl = it.slice
+        if isinstance(sl, ast.Constant) and sl.value == "ops":
+            return True
+    return False
+
+
+def _check_change_loops(ctx: FileContext, findings: list) -> None:
+    """AM107: per-change/per-op ``for`` statements in gate/transcode hot
+    paths — the work belongs in batched column programs."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.For) and _is_change_loop(node):
+            findings.append(ctx.finding(
+                "AM107", node,
+                "per-change/per-op Python loop in a gate/transcode hot "
+                "path: compute gate verdicts from dep-index columns "
+                "(transcode.gate_verdicts) and take op rows from cached "
+                "column blocks — scalar-oracle loops carry justified "
+                "suppressions",
+            ))
+
+
 def _check_byte_loops(ctx: FileContext, findings: list) -> None:
     """AM106: a while/for loop whose body both subscripts a buffer-named
     value and advances a cursor by one — the per-byte scalar decode shape
@@ -163,6 +221,7 @@ def check(ctxs: list[FileContext]) -> list[Finding]:
             _check_byte_loops(ctx, findings)
         if not _in_scope(ctx):
             continue
+        _check_change_loops(ctx, findings)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
                 spelling = _is_key_lambda_sort(node)

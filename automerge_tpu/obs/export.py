@@ -40,6 +40,8 @@ from .scope import get_amscope
 #: serve.phase.* histogram suffix -> breakdown key
 _PHASE_KEYS = {
     "serve.phase.decode_ms": "decode",
+    "serve.phase.gate_verdicts_ms": "gate_verdicts",
+    "serve.phase.transcode_columns_ms": "transcode_columns",
     "serve.phase.gate_transcode_ms": "gate_transcode",
     "serve.phase.pack_ms": "pack",
     "serve.phase.device_dispatch_ms": "device_dispatch",
@@ -116,7 +118,8 @@ def request_breakdown(metrics_snapshot: dict) -> dict:
     # still names where the dispatch time went.
     host_dispatch = sum(
         phases.get(k, 0.0)
-        for k in ("decode", "gate_transcode", "pack", "device_dispatch")
+        for k in ("decode", "gate_verdicts", "transcode_columns",
+                  "gate_transcode", "pack", "device_dispatch")
     )
     dispatch = max(dispatch_total - readback - assembly, host_dispatch)
     parts = {

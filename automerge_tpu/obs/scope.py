@@ -71,9 +71,17 @@ PHASE_HISTOGRAMS: dict[str, Histogram] = {
     "decode": _METRICS.histogram(
         "serve.phase.decode_ms", "chunk decode share of serve dispatches"
     ),
+    "gate_verdicts": _METRICS.histogram(
+        "serve.phase.gate_verdicts_ms",
+        "columnar causal-gate verdict share of serve dispatches",
+    ),
+    "transcode_columns": _METRICS.histogram(
+        "serve.phase.transcode_columns_ms",
+        "cached-column transcode share of serve dispatches",
+    ),
     "gate+transcode": _METRICS.histogram(
         "serve.phase.gate_transcode_ms",
-        "causal gate + row transcode share of serve dispatches",
+        "scalar-oracle gate + row transcode share of serve dispatches",
     ),
     "pack": _METRICS.histogram(
         "serve.phase.pack_ms", "batch packing share of serve dispatches"

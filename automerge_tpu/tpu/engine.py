@@ -392,6 +392,7 @@ from .paging import (  # noqa: E402
     paged_probe_ops,
     paged_visible_plain,
     paged_visible_ranked,
+    patch_column_rows,
 )
 
 
@@ -615,6 +616,47 @@ class BatchedMapEngine:
         v, t = _dispatch(_gather_rows, visible, totals, jnp.asarray(idx))
         v, t = jax.device_get((v, t))
         return v[:n], t[:n]
+
+    def read_patch_columns(self, plan, actor_rank):
+        """Scoped readback + device patch-column emission: `plan` is a
+        list of ``(doc, row_idx array, cut array)`` triples, where `cut`
+        holds each requested row's walk cutoff as a rank-packed int64
+        (``-1`` = the row's slot is outside the delivery's cutoff set,
+        int64 max = walk to the end of the key run). Returns
+        (visible, value_total, emit) numpy arrays concatenated in plan
+        order. Visibility comes from the memoised stable-shape program
+        (visible_state), then paging.patch_column_rows gathers exactly
+        the requested rows and decides patch emission on device — the
+        shape-varying half compiles in milliseconds, so growing readback
+        sizes never re-pay the visibility kernel's compile."""
+        plan = [
+            (int(d), np.asarray(idx, np.int64), np.asarray(cut, np.int64))
+            for d, idx, cut in plan if len(idx)
+        ]
+        if not plan:
+            return (
+                np.zeros(0, bool), np.zeros(0, np.int64), np.zeros(0, bool)
+            )
+        docs_t = tuple(sorted({d for d, _, _ in plan}))
+        _k, op, visible, _w, totals = self.visible_state(
+            actor_rank, docs=docs_t
+        )
+        w = visible.shape[1]
+        pos = {d: i for i, d in enumerate(docs_t)}
+        flat = np.concatenate([pos[d] * w + idx for d, idx, _ in plan])
+        cuts = np.concatenate([cut for _, _, cut in plan])
+        n = int(flat.shape[0])
+        padded = 1 << max(0, n - 1).bit_length()
+        idx = np.zeros(padded, np.int64)
+        idx[:n] = flat
+        cut = np.full(padded, -1, np.int64)  # pad rows never emit
+        cut[:n] = cuts
+        v, t, e = _dispatch(
+            patch_column_rows, visible, totals, op,
+            jnp.asarray(actor_rank), jnp.asarray(idx), jnp.asarray(cut),
+        )
+        v, t, e = jax.device_get((v, t, e))
+        return v[:n], t[:n], e[:n]
 
     def dense_view(self, docs=None):
         """Host copies of the six op columns as dense [D, W] arrays (the

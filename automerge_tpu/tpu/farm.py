@@ -56,7 +56,9 @@ historical all-or-nothing contract. See README "Fault isolation".
 """
 from __future__ import annotations
 
+import os
 import time
+from collections import OrderedDict
 from typing import NamedTuple
 
 import numpy as np
@@ -88,9 +90,12 @@ from .engine import (
     changes_from_numpy,
 )
 from .transcode import (
+    DEP_COMMITTED,
+    DEP_UNKNOWN,
     _Interner,
     _MAX_SLOTS,
     actor_rank_table,
+    gate_verdicts,
     lamport_keys,
     ragged_spans,
 )
@@ -198,6 +203,25 @@ _M_VECTOR_ROWS = _METRICS.counter(
     "farm.assembly.vector_rows",
     "rows processed by the vectorized (column-mask) assembly path",
 )
+_M_VEC_CHANGES = _METRICS.counter(
+    "farm.gate.vector_changes",
+    "changes gated by the columnar verdict program (transcode.gate_verdicts)",
+)
+_M_DEV_COLS = _METRICS.counter(
+    "farm.patch.device_columns",
+    "patch rows whose emit mask was computed on device by the fused "
+    "visibility+patch-columns program",
+)
+_M_GATE_ORACLE = _METRICS.counter(
+    "farm.gate.oracle_docs",
+    "docs routed to the scalar gate oracle before verdicts (uncacheable "
+    "ops or in-delivery duplicate hashes)",
+)
+_M_TC_ORACLE = _METRICS.counter(
+    "farm.transcode.oracle_docs",
+    "docs re-routed to the scalar chain after verdicts (seq/ref anomalies "
+    "whose canonical error the oracle owns)",
+)
 # amscope hooks: the dispatch/readback latency histograms carry the
 # ambient serve DispatchSpan id as their bucket exemplar, so a farm-side
 # latency spike links back to the batched request traces it served.
@@ -288,6 +312,64 @@ class FarmApplyResult(list):
         }
 
 
+#: cache sentinel for changes the columnar builder cannot express
+_UNCACHEABLE = object()
+
+
+class _ChangeCols:
+    """One decoded change transcoded ONCE into column form (cached per
+    change hash): the dense row array plus every per-doc side effect of
+    `_op_rows` recorded as replayable data. A change gossiped to N
+    documents builds its columns a single time; committing it to a doc
+    replays the recorded effects (counter registration, inc max-merge,
+    child metas) without any per-op Python. List/text ops and unknown
+    actions are uncacheable (the builder returns None): they mutate
+    order-dependent per-doc element state, so their docs route through
+    the scalar oracle chain."""
+
+    __slots__ = (
+        "hash", "actor", "seq", "deps", "max_ctr", "arr", "counter_packed",
+        "inc_updates", "starved", "children", "objs", "external_refs",
+        "cut_slots", "cut_packed", "_sorted",
+    )
+
+    def __init__(self, change, max_ctr, arr, counter_packed, inc_updates,
+                 starved, children, objs, external_refs, cut_slots,
+                 cut_packed):
+        self.hash = change["hash"]
+        self.actor = change["actor"]
+        self.seq = change["seq"]
+        self.deps = tuple(change["deps"])
+        self.max_ctr = max_ctr
+        self.arr = arr
+        self.counter_packed = counter_packed
+        self.inc_updates = inc_updates
+        self.starved = starved
+        self.children = children
+        self.objs = objs
+        self.external_refs = external_refs
+        self.cut_slots = cut_slots
+        self.cut_packed = cut_packed
+        self._sorted = None
+
+    def sorted_cols(self):
+        """Mirror-weave columns in merge-key order, lazily sorted once and
+        shared by every doc the change merges into:
+        (mkey sorted, key32, op, action32, unique slots)."""
+        if self._sorted is None:
+            arr = self.arr
+            mkey = (arr[:, 0] << _MKEY_OP_BITS) | arr[:, 1]
+            order = np.argsort(mkey, kind="stable")
+            self._sorted = (
+                mkey[order],
+                arr[order, 0].astype(np.int32),
+                arr[order, 1],
+                arr[order, 2].astype(np.int32),
+                np.unique(arr[:, 0]),
+            )
+        return self._sorted
+
+
 class TpuDocFarm:
     """N documents, one device engine. See module docstring.
 
@@ -298,7 +380,16 @@ class TpuDocFarm:
 
     def __init__(self, num_docs: int, capacity: int = 1024,
                  quarantine_threshold: int | None = 3,
-                 page_size: int | None = None):
+                 page_size: int | None = None,
+                 gate_mode: str | None = None):
+        # "columnar" gates whole deliveries with verdict columns
+        # (transcode.gate_verdicts) and commits ready changes from cached
+        # column arrays; "oracle" pins every doc to the scalar gate chain
+        # (the parity oracle the columnar path re-routes anomalies to).
+        gate_mode = gate_mode or os.environ.get("AM_GATE_MODE", "columnar")
+        if gate_mode not in ("columnar", "oracle"):
+            raise ValueError(f"unknown gate mode: {gate_mode!r}")  # amlint: disable=AM401 — API-usage validation
+        self.gate_mode = gate_mode
         self.num_docs = num_docs
         self.engine = BatchedMapEngine(num_docs, capacity, page_size=page_size)
         # interners are shared across the batch: actor ids, (objectId, key)
@@ -375,6 +466,12 @@ class TpuDocFarm:
         # interned value ids that hold ChildObj cells (child detection in
         # the vectorized children-cache update without a lookup per row)
         self._child_value_ids: set[int] = set()
+        # columnar-gate caches: change hash -> _ChangeCols (a change
+        # gossiped to N docs transcodes once), packed opid -> "ctr@actor",
+        # value id -> leaf valueDiff template (device-column assembly)
+        self._cols_cache: OrderedDict = OrderedDict()
+        self._opid_strs: dict[int, str] = {}
+        self._leaf_tpls: dict[int, dict] = {}
 
     # ------------------------------------------------------------------ #
     # transcoding
@@ -604,6 +701,8 @@ class TpuDocFarm:
                 cutoffs[slot] = self._INF if i == last else release
 
         last_batch = None
+        # amlint: disable=AM107 — scalar-oracle cutoff walk: the columnar
+        # path precomputes cut columns once per distinct change hash
         for op, ctr, actor, gate_batch in applied_ops:
             if gate_batch != last_batch:
                 close(run)
@@ -653,6 +752,9 @@ class TpuDocFarm:
         clock = dict(self.clock[d])
         round_hashes = set()
         applied, enqueued = [], []
+        # amlint: disable=AM107 — the scalar causal gate IS the parity
+        # oracle the columnar verdicts are tested against; anomalous docs
+        # re-route here for the canonical result/error
         for change in pending:
             if (
                 change["hash"] in self.change_index_by_hash[d]
@@ -691,6 +793,312 @@ class TpuDocFarm:
         return applied, enqueued
 
     # ------------------------------------------------------------------ #
+    # columnar causal gate (gate_mode="columnar"): verdict columns for a
+    # whole delivery at once (transcode.gate_verdicts) + per-change column
+    # arrays cached across docs, with the scalar chain above as the
+    # bit-for-bit parity oracle for anything the columns cannot express
+
+    def _build_change_cols(self, change):
+        """Columnar form of one decoded change, or None when any op falls
+        outside the cacheable map-family subset. Mirrors `_op_rows` row
+        for row (primary + marker rows); doc-independent because map-family
+        rows only consult the shared interners, never per-doc state.
+        Interner entries created here survive even if the change never
+        commits — they are append-only lookup tables, never doc state
+        (same policy as rollback)."""
+        rows = []
+        counter_packed = []
+        inc_updates = []
+        starved = []
+        children = []
+        local_children = set()
+        external = []
+        objs = set()
+        actor = change["actor"]
+        actor_idx = self.actors.intern(actor)
+        ctr = change["startOp"]
+        # amlint: disable=AM107 — columnar-cache builder: runs ONCE per
+        # distinct change hash (LRU across the whole farm), not per
+        # (doc, op) delivery; every doc replays the recorded columns
+        for op in change["ops"]:
+            if "key" not in op or op.get("insert") or op.get("elemId") is not None:
+                return None
+            obj, key = op["obj"], op["key"]
+            objs.add(obj)
+            if obj != "_root" and obj not in local_children:
+                external.append(obj)
+            slot = self.slots.intern((obj, key))
+            packed = (ctr << ACTOR_BITS) | actor_idx
+            preds = [self._pack_opid(p) for p in op.get("pred", ())]
+            action = op["action"]
+            if action == "set":
+                datatype = op.get("datatype")
+                if datatype == "counter":
+                    counter_packed.append(packed)
+                    value = int(op["value"])
+                else:
+                    value = self.values.intern(ValueCell(op["value"], datatype))
+                rows.append((slot, packed, ACTION_SET, value,
+                             preds[0] if preds else -1))
+            elif action in _MAKE_TYPES:
+                child_id = f"{ctr}@{actor}"
+                value = self.values.intern(ChildObj(child_id))
+                self._child_value_ids.add(value)
+                children.append((child_id, {
+                    "parentObj": obj,
+                    "parentKey": key,
+                    "type": _MAKE_TYPES[action],
+                }))
+                local_children.add(child_id)
+                rows.append((slot, packed, ACTION_SET, value,
+                             preds[0] if preds else -1))
+            elif action == "inc":
+                lam = (ctr, actor)
+                for target in op.get("pred", ()):
+                    inc_updates.append((self._pack_opid(target), lam))
+                rows.append((slot, packed, ACTION_INC, int(op["value"]),
+                             preds[-1] if preds else -1))
+                for extra in preds[:-1]:
+                    starved.append(extra)
+                    rows.append((slot, packed, ACTION_INC, 0, extra))
+                ctr += 1
+                continue
+            elif action == "del":
+                rows.append((slot, packed, ACTION_DEL, 0,
+                             preds[0] if preds else -1))
+            else:
+                return None
+            for extra in preds[1:]:
+                rows.append((slot, packed, ACTION_DEL, 0, extra))
+            ctr += 1
+        max_ctr = ctr - 1
+        arr = np.asarray(rows, np.int64).reshape(-1, 5)
+        # single-change cutoffs are doc-independent too (`_compute_cutoffs`
+        # only consults slots/keys/actor): cache them as rank-translatable
+        # columns — ctr << ACTOR_BITS | actor INDEX, int64 max = walk to end
+        applied_ops = [
+            (op, change["startOp"] + i, actor, 1)
+            for i, op in enumerate(change["ops"])
+        ]
+        cut_items = sorted(self._compute_cutoffs(None, applied_ops).items())
+        cut_slots = np.asarray([s for s, _ in cut_items], np.int64)
+        cut_packed = np.empty(len(cut_items), np.int64)
+        inf = np.iinfo(np.int64).max
+        for k, (_s, cut) in enumerate(cut_items):
+            if cut[0] == float("inf"):
+                cut_packed[k] = inf
+            else:
+                cut_packed[k] = (int(cut[0]) << ACTOR_BITS) | self.actors.intern(cut[1])
+        return _ChangeCols(
+            change, max_ctr, arr, counter_packed, inc_updates, starved,
+            children, objs, tuple(dict.fromkeys(external)), cut_slots,
+            cut_packed,
+        )
+
+    def _change_cols(self, change):
+        """LRU-cached `_build_change_cols`. Builder exceptions cache as
+        uncacheable — the scalar oracle chain owns the canonical error."""
+        cache = self._cols_cache
+        h = change["hash"]
+        cols = cache.get(h)
+        if cols is not None:
+            cache.move_to_end(h)
+            return None if cols is _UNCACHEABLE else cols
+        try:
+            cols = self._build_change_cols(change)
+        except Exception:
+            cols = None
+        cache[h] = _UNCACHEABLE if cols is None else cols
+        if len(cache) > 4096:
+            cache.popitem(last=False)
+        return cols
+
+    def _gate_verdict_columns(self, per_doc_decoded):
+        """Causal-gate verdicts for the whole delivery as column programs:
+        per doc, assemble dep-index columns over (decoded + queued) entries
+        and run `transcode.gate_verdicts` for commit order / deferrals in
+        one pass. Returns (plans, scalar_docs): plans[d] =
+        (pend, cols_list, batch, order); scalar_docs re-route through the
+        scalar oracle (uncacheable ops, in-delivery duplicate hashes, or
+        seq/ref anomalies whose canonical error the oracle owns)."""
+        plans = {}
+        scalar_docs = []
+        vec_changes = 0
+        for d, decoded in enumerate(per_doc_decoded):
+            if not decoded:
+                # no new changes: queued entries cannot become ready (their
+                # missing deps only arrive with a commit), and the queue
+                # holds no committed duplicates — the scalar loop would be
+                # a no-op for this doc
+                continue
+            pend0 = decoded + self.queue[d] if self.queue[d] else decoded
+            index = self.change_index_by_hash[d]
+            pend = []
+            positions = {}
+            dup = False
+            for c in pend0:
+                h = c["hash"]
+                if h in index:
+                    continue  # committed duplicate: silently dropped
+                if h in positions:
+                    dup = True  # in-delivery duplicate: oracle owns dedup
+                    break
+                positions[h] = len(pend)
+                pend.append(c)
+            if dup:
+                scalar_docs.append(d)
+                _M_GATE_ORACLE.inc()
+                continue
+            if not pend:
+                self.queue[d] = []
+                continue
+            cols_list = [self._change_cols(c) for c in pend]
+            if any(cols is None for cols in cols_list):
+                scalar_docs.append(d)
+                _M_GATE_ORACLE.inc()
+                continue
+            if all(dep in index for c in pend for dep in c["deps"]):
+                # every dep already committed (the steady-state shape:
+                # deliveries extending known heads) — gate_verdicts would
+                # assign batch 1 everywhere and keep delivery order
+                batch = np.ones(len(pend), np.int64)
+                order = np.arange(len(pend))
+            else:
+                dep_idx = []
+                dep_counts = np.empty(len(pend), np.int64)
+                for i, c in enumerate(pend):
+                    deps = c["deps"]
+                    dep_counts[i] = len(deps)
+                    for dep in deps:
+                        if dep in index:
+                            dep_idx.append(DEP_COMMITTED)
+                        else:
+                            dep_idx.append(positions.get(dep, DEP_UNKNOWN))
+                batch = gate_verdicts(dep_idx, dep_counts)
+                committed = np.nonzero(batch > 0)[0]
+                order = committed[np.argsort(batch[committed], kind="stable")]
+            if not self._validate_commit(d, pend, cols_list, order):
+                scalar_docs.append(d)
+                _M_TC_ORACLE.inc()
+                continue
+            plans[d] = (pend, cols_list, batch, order)
+            vec_changes += len(pend)
+        if _METRICS.enabled and vec_changes:
+            _M_VEC_CHANGES.inc(vec_changes)
+        return plans, scalar_docs
+
+    def _validate_commit(self, d, pend, cols_list, order):
+        """Checks the anomalies the scalar gate/transcode raises on —
+        per-actor seq contiguity over the commit order, and external object
+        refs resolving against committed state + earlier-committed makes.
+        Returns False to re-route the doc through the scalar chain, which
+        owns the canonical error (and its offending_hashes)."""
+        seqs = {}
+        known = self.object_meta[d]
+        made = set()
+        for i in order:
+            c = pend[int(i)]
+            cols = cols_list[int(i)]
+            actor = c["actor"]
+            expected = seqs.get(actor)
+            if expected is None:
+                expected = self.clock[d].get(actor, 0) + 1
+            if c["seq"] != expected:
+                return False
+            seqs[actor] = expected + 1
+            for obj in cols.external_refs:
+                if obj not in known and obj not in made:
+                    return False
+            for child_id, _meta in cols.children:
+                made.add(child_id)
+        return True
+
+    def _transcode_columns(self, d, plan, per_doc_arrays, applied_ops,
+                           touched_objects, applied_changes, col_cuts,
+                           mirror_pre):
+        """Commits one doc's gate verdicts: replays each ready change's
+        cached column side effects (the bookkeeping the scalar loop does
+        per op) and takes the doc's dense row array straight from the
+        cached column blocks — zero per-op Python on this path."""
+        pend, cols_list, batch, order = plan
+        deferred = [pend[i] for i in range(len(pend)) if batch[i] == 0]
+        if len(deferred) == len(pend):
+            self.queue[d] = deferred
+            return
+        clock = dict(self.clock[d])
+        heads = set(self.heads[d])
+        arrays = []
+        multi = len(pend) - len(deferred) > 1
+        for i in order:
+            change = pend[int(i)]
+            cols = cols_list[int(i)]
+            clock[change["actor"]] = change["seq"]
+            for dep in change["deps"]:
+                heads.discard(dep)
+            heads.add(change["hash"])
+            arrays.append(cols.arr)
+            touched_objects[d] |= cols.objs
+            self.max_op[d] = max(self.max_op[d], cols.max_ctr)
+            applied_changes[d].append(change)
+            self.changes[d].append(change["buffer"])
+            self.change_index_by_hash[d][change["hash"]] = (
+                len(self.changes[d]) - 1
+            )
+            by_actor = self.hashes_by_actor[d].setdefault(change["actor"], [])
+            while len(by_actor) < change["seq"]:
+                by_actor.append(None)
+            by_actor[change["seq"] - 1] = change["hash"]
+            self.dependencies_by_hash[d][change["hash"]] = list(change["deps"])
+            self.dependents_by_hash[d].setdefault(change["hash"], [])
+            for dep in change["deps"]:
+                self.dependents_by_hash[d].setdefault(dep, []).append(
+                    change["hash"]
+                )
+            if cols.counter_packed:
+                self.counter_ops[d].update(cols.counter_packed)
+            for target, lam in cols.inc_updates:
+                cur = self.inc_max[d].get(target)
+                if cur is None or cur < lam:
+                    self.inc_max[d][target] = lam
+            if cols.starved:
+                self.starved[d].update(cols.starved)
+            for child_id, meta in cols.children:
+                self.object_meta[d][child_id] = dict(meta)
+            if multi:
+                ctr = change["startOp"]
+                gb = int(batch[int(i)])
+                # amlint: disable=AM107 — multi-change cutoff
+                # materialisation: bounded by delivery size; single-change
+                # deliveries (the steady state) reuse the cached cutoff
+                # columns and never run this
+                for op in change["ops"]:
+                    applied_ops[d].append((op, ctr, change["actor"], gb))
+                    ctr += 1
+        self.clock[d] = clock
+        self.heads[d] = sorted(heads)
+        self.queue[d] = deferred
+        arr = arrays[0] if len(arrays) == 1 else np.vstack(arrays)
+        if arr.shape[0]:
+            per_doc_arrays[d] = arr
+            if not multi:
+                cols = cols_list[int(order[0])]
+                col_cuts[d] = (cols.cut_slots, cols.cut_packed)
+                mirror_pre[d] = cols.sorted_cols()
+
+    def _cutoffs_from_cols(self, cuts):
+        """Rebuilds the {slot: lamport-cutoff} dict `_build_diffs` expects
+        from cached cutoff columns (actor-INDEX packed; int64 max = walk
+        to the end of the key run)."""
+        cut_slots, cut_packed = cuts
+        inf = np.iinfo(np.int64).max
+        out = {}
+        for slot, cut in zip(cut_slots.tolist(), cut_packed.tolist()):
+            out[slot] = self._INF if cut == inf else (
+                cut >> ACTOR_BITS, self.actors.lookup(cut & ACTOR_MASK)
+            )
+        return out
+
+    # ------------------------------------------------------------------ #
     # the reference merge walk (lazily embedded per doc)
 
     def _ensure_exact(self, d: int) -> OpSet:
@@ -701,6 +1109,8 @@ class TpuDocFarm:
             opset = OpSet()
             if self.changes[d]:
                 opset.apply_changes(list(self.changes[d]))
+            # amlint: disable=AM107 — cold path: one-time OpSet rebuild
+            # when a doc first needs the reference walk
             for change in self.queue[d]:
                 opset.apply_changes([change["buffer"]])
             self.exact[d] = opset
@@ -745,11 +1155,15 @@ class TpuDocFarm:
         inserts = 0
         insert_hashes = set()
         seen = set()
+        # amlint: disable=AM107 — packing-limit prevalidation must walk
+        # every candidate op to count list inserts BEFORE any commit;
+        # it guards the quarantine boundary, not a throughput phase
         for change in list(decoded_changes) + list(self.queue[d]):
             if change["hash"] in self.change_index_by_hash[d] or change["hash"] in seen:
                 continue
             seen.add(change["hash"])
             ctr = change["startOp"]
+            # amlint: disable=AM107 — same prevalidation walk, op level
             for op in change["ops"]:
                 if ctr >= rga.MAX_COUNTER:
                     exc = PackingLimitError(
@@ -811,6 +1225,7 @@ class TpuDocFarm:
         prof = get_profile()
         assert len(per_doc_buffers) == self.num_docs
         per_doc_rows = [[] for _ in range(self.num_docs)]
+        per_doc_arrays = [None] * self.num_docs
         applied_ops = [[] for _ in range(self.num_docs)]
         touched_objects = [set() for _ in range(self.num_docs)]
         applied_changes = [[] for _ in range(self.num_docs)]
@@ -825,10 +1240,22 @@ class TpuDocFarm:
             """Captures one doc's failure: rolls its state back, drops its
             rows/patch work, and counts the cause by error_kind."""
             if d in snapshots:
-                self._restore_doc(d, snapshots.pop(d))
+                # the rolled-back delivery never reached the mirror or the
+                # device (the merge replays only after every doc committed,
+                # and a failed dispatch advances nothing), so only the
+                # spans it MEANT to touch need a re-read
+                arr = per_doc_arrays[d]
+                if arr is not None:
+                    stale = np.unique(arr[:, 0]).tolist()
+                elif per_doc_rows[d]:
+                    stale = {int(r[0]) for r in per_doc_rows[d]}
+                else:
+                    stale = ()
+                self._restore_doc(d, snapshots.pop(d), stale_slots=stale)
             failures[d] = exc
             per_doc_decoded[d] = []
             per_doc_rows[d] = []
+            per_doc_arrays[d] = None
             applied_ops[d] = []
             touched_objects[d] = set()
             applied_changes[d] = []
@@ -938,10 +1365,42 @@ class TpuDocFarm:
                         self.exact[d] = None
                         quarantine(d, exc)
 
+        # snapshot + columnar verdicts: the whole delivery's gate decisions
+        # (commit order / deferrals) come from one dep-column program per
+        # doc (transcode.gate_verdicts); docs the columns cannot express
+        # re-route through the scalar oracle below, which owns the
+        # canonical result/error. Batch isolation keeps the historical
+        # all-scalar behaviour (one raise aborts the call).
+        use_columnar = doc_mode and self.gate_mode == "columnar"
+        col_cuts: dict[int, tuple] = {}
+        mirror_pre: dict[int, tuple] = {}
+        with prof.phase("gate_verdicts"):
+            if doc_mode:
+                for d, decoded in enumerate(per_doc_decoded):
+                    if decoded:
+                        snapshots[d] = self._snapshot_doc(d)
+            if use_columnar:
+                plans, scalar_docs = self._gate_verdict_columns(per_doc_decoded)
+            else:
+                plans, scalar_docs = {}, range(self.num_docs)
+
+        with prof.phase("transcode_columns"):
+            for d, plan in plans.items():
+                try:
+                    self._transcode_columns(
+                        d, plan, per_doc_arrays, applied_ops,
+                        touched_objects, applied_changes, col_cuts,
+                        mirror_pre,
+                    )
+                except Exception as exc:
+                    self.exact[d] = None
+                    col_cuts.pop(d, None)
+                    mirror_pre.pop(d, None)
+                    quarantine(d, exc)
+
         with prof.phase("gate+transcode"):
-            for d, decoded in enumerate(per_doc_decoded):
-                if doc_mode and decoded:
-                    snapshots[d] = self._snapshot_doc(d)
+            for d in scalar_docs:
+                decoded = per_doc_decoded[d]
                 pending = decoded + self.queue[d] if self.queue[d] else decoded
                 gate_batch = 0
                 try:
@@ -950,8 +1409,13 @@ class TpuDocFarm:
                         if not applied:
                             break
                         gate_batch += 1
+                        # amlint: disable=AM107 — scalar-oracle transcode:
+                        # docs land here only on gate_mode="oracle" or an
+                        # anomaly re-route; the chain owns the canonical
+                        # result and its offending_hashes
                         for change in applied:
                             ctr = change["startOp"]
+                            # amlint: disable=AM107 — same oracle chain
                             for op in change["ops"]:
                                 rows = self._op_rows(d, op, ctr, change["actor"])
                                 per_doc_rows[d].extend(rows)
@@ -1009,26 +1473,28 @@ class TpuDocFarm:
 
         # one device merge for the ACTIVE docs only: the paged engine
         # gathers just their rows from the slab, so idle documents cost
-        # neither HBM traffic nor kernel work
-        width = max((len(r) for r in per_doc_rows), default=0)
+        # neither HBM traffic nor kernel work. Columnar-gated docs already
+        # carry their dense row arrays (cached column blocks); scalar-gated
+        # docs densify their row lists here.
         device_failed = False
-        per_doc_arrays = [None] * self.num_docs
+        for d, rows in enumerate(per_doc_rows):
+            if rows and per_doc_arrays[d] is None:
+                per_doc_arrays[d] = np.asarray(rows, np.int64)
+        width = max(
+            (a.shape[0] for a in per_doc_arrays if a is not None), default=0
+        )
         active = ()
         if width > 0:
-            # dense row columns per doc, shared by pack, the bisect probes
-            # and the host mirror merge
-            for d, rows in enumerate(per_doc_rows):
-                if rows:
-                    per_doc_arrays[d] = np.asarray(rows, np.int64)
             active = tuple(
-                d for d in range(self.num_docs) if per_doc_rows[d]
+                d for d in range(self.num_docs)
+                if per_doc_arrays[d] is not None
             )
             if _METRICS.enabled:
                 # pad waste is measured over the ACTIVE docs' cells: idle
                 # documents no longer ride the dispatch at all (the paged
                 # engine gathers only active rows), and the pow2 doc-count
                 # bucket is the bounded price of shape caching, not waste
-                rows = sum(len(r) for r in per_doc_rows)
+                rows = sum(per_doc_arrays[d].shape[0] for d in active)
                 cells = len(active) * width
                 _M_ROWS.inc(rows)
                 _M_PAD_ROWS.inc(cells - rows)
@@ -1097,16 +1563,36 @@ class TpuDocFarm:
             d for d in range(self.num_docs)
             if d not in exact_patches and d not in failures
         ]
+        emit_info: dict[int, tuple] = {}
         with prof.phase("visibility"):
             if width > 0 and not device_failed:
                 # replicate the committed merge on the host mirror (exact
                 # device row order, no transfer), then refresh the stale
-                # (doc, slot) visibility spans with one scoped gather
+                # (doc, slot) visibility spans with one scoped gather.
+                # Docs whose whole delivery is a single cached columnar
+                # change on counter-free, child-free state take the FUSED
+                # program: visibility + row gather + patch emit mask in one
+                # dispatch (engine.read_patch_columns), leaving only
+                # column->JSON materialisation for patch assembly.
                 for d, arr in enumerate(per_doc_arrays):
                     if arr is not None:
-                        self._merge_mirror(d, arr)
+                        self._merge_mirror(d, arr, pre=mirror_pre.get(d))
+                vis_docs = [
+                    d for d in need_device_patch
+                    if per_doc_arrays[d] is not None
+                ]
+                fast = []
+                if not self._child_value_ids:
+                    fast = [
+                        d for d in vis_docs
+                        if d in col_cuts
+                        and not self.counter_ops[d]
+                        and not self.children[d]
+                    ]
+                if fast:
+                    emit_info = self._refresh_patch_columns(fast, col_cuts)
                 self._refresh_visibility(
-                    [d for d in need_device_patch if applied_ops[d]]
+                    [d for d in vis_docs if d not in emit_info]
                 )
         with prof.phase("patch_assembly"):
             patches = []
@@ -1132,8 +1618,19 @@ class TpuDocFarm:
                 if d in exact_patches:
                     patches.append(exact_patches[d])
                     continue
-                cutoffs = self._compute_cutoffs(d, applied_ops[d])
-                diffs = self._build_diffs(d, cutoffs, touched_objects[d])
+                if d in emit_info:
+                    idx_e, emit_e = emit_info[d]
+                    diffs = self._build_diffs_columns(
+                        d, idx_e, emit_e, col_cuts[d][0], touched_objects[d]
+                    )
+                elif d in col_cuts:
+                    diffs = self._build_diffs(
+                        d, self._cutoffs_from_cols(col_cuts[d]),
+                        touched_objects[d],
+                    )
+                else:
+                    cutoffs = self._compute_cutoffs(d, applied_ops[d])
+                    diffs = self._build_diffs(d, cutoffs, touched_objects[d])
                 patch = {
                     "maxOp": self.max_op[d],
                     "clock": self.clock[d],
@@ -1192,11 +1689,19 @@ class TpuDocFarm:
             "page_rows": int(self.engine.lengths[d]),
         }
 
-    def _restore_doc(self, d: int, snap: dict) -> None:
+    def _restore_doc(self, d: int, snap: dict,
+                     stale_slots=None) -> None:
         """Rolls doc `d` back to its snapshot (quarantine path). Shared
         interner entries created by the rolled-back transcode are left
         behind deliberately: they are append-only lookup tables, never
-        document state."""
+        document state.
+
+        `stale_slots` scopes the visibility invalidation to the slots the
+        failed delivery actually touched: the delivery never reached the
+        mirror or the device (both commit only after every doc's gate), so
+        the rest of the doc's cached spans are still exact. None keeps the
+        conservative whole-doc invalidation for callers without span
+        knowledge."""
         self.object_meta[d] = snap["object_meta"]
         self.clock[d] = snap["clock"]
         self.heads[d] = snap["heads"]
@@ -1215,11 +1720,12 @@ class TpuDocFarm:
         self.elem_ids[d] = snap["elem_ids"]
         self.elem_object[d] = snap["elem_object"]
         self.engine.restore_doc(d, snap["pages"], snap["page_rows"])
-        # a rolled-back delivery must never be served stale visibility:
-        # conservatively mark every span of the doc for re-read (cheap —
-        # rollback is the rare path)
-        self._vis_all_stale[d] = True
-        self._vis_stale[d].clear()
+        # a rolled-back delivery must never be served stale visibility
+        if stale_slots is None:
+            self._vis_all_stale[d] = True
+            self._vis_stale[d].clear()
+        elif not self._vis_all_stale[d]:
+            self._vis_stale[d].update(int(s) for s in stale_slots)
 
     def _noop_patch(self, d: int) -> dict:
         """The patch of a delivery that changed nothing (quarantined/shed):
@@ -1315,6 +1821,8 @@ class TpuDocFarm:
         if committed:
             opset.apply_changes(list(committed))
         queued = snap["queue"] if snap is not None else self.queue[d]
+        # amlint: disable=AM107 — reference-walk parity replay, cold by
+        # construction (list/text docs only)
         for change in queued:
             opset.apply_changes([change["buffer"]])
         patch = opset.apply_changes(list(delivered_buffers), is_local)
@@ -1580,29 +2088,63 @@ class TpuDocFarm:
     # a delivery touching 3 objects in 2 documents reads back a handful of
     # rows, not the whole farm state.
 
-    def _merge_mirror(self, d, arr):
+    def _merge_mirror(self, d, arr, pre=None):
         """Replays a committed device merge on doc `d`'s host mirror.
         `arr` is the [n, 5] (slot, op, action, value, pred) column array
         this call dispatched; rows land at exactly the device's insert
         positions (stable sort + left-searchsorted, so multi-pred marker
-        rows keep sorting directly after their primary)."""
-        mkey = (arr[:, 0] << _MKEY_OP_BITS) | arr[:, 1]
-        order = np.argsort(mkey, kind="stable")
-        mkey = mkey[order]
-        pos = np.searchsorted(self._vis_mkey[d], mkey)
-        self._vis_mkey[d] = np.insert(self._vis_mkey[d], pos, mkey)
-        self._vis_key[d] = np.insert(
-            self._vis_key[d], pos, arr[order, 0].astype(np.int32)
-        )
-        self._vis_op[d] = np.insert(self._vis_op[d], pos, arr[order, 1])
-        self._vis_action[d] = np.insert(
-            self._vis_action[d], pos, arr[order, 2].astype(np.int32)
-        )
-        # placeholders until the scoped readback refreshes these spans
-        self._vis_visible[d] = np.insert(self._vis_visible[d], pos, False)
-        self._vis_total[d] = np.insert(self._vis_total[d], pos, 0)
+        rows keep sorting directly after their primary).
+
+        `pre` optionally carries the change's cached merge-key-sorted
+        columns (_ChangeCols.sorted_cols) so the sort and column casts are
+        amortised across every doc the change was gossiped to; the weave
+        itself is two whole-column fills per column instead of six
+        np.inserts."""
+        if pre is None:
+            mkey = (arr[:, 0] << _MKEY_OP_BITS) | arr[:, 1]
+            order = np.argsort(mkey, kind="stable")
+            pre = (
+                mkey[order],
+                arr[order, 0].astype(np.int32),
+                arr[order, 1],
+                arr[order, 2].astype(np.int32),
+                np.unique(arr[:, 0]),
+            )
+        mkey_s, key32, opcol, act32, uniq = pre
+        old = self._vis_mkey[d]
+        m = mkey_s.shape[0]
+        if old.shape[0] == 0:
+            # fresh doc: the cached sorted columns ARE the mirror (shared
+            # across docs; mirror columns are only ever replaced wholesale
+            # or scatter-written into visible/total, which are fresh here)
+            self._vis_mkey[d] = mkey_s
+            self._vis_key[d] = key32
+            self._vis_op[d] = opcol
+            self._vis_action[d] = act32
+            self._vis_visible[d] = np.zeros(m, bool)
+            self._vis_total[d] = np.zeros(m, np.int64)
+        else:
+            pos = np.searchsorted(old, mkey_s)
+            total = old.shape[0] + m
+            new_pos = pos + np.arange(m)
+            keep = np.ones(total, bool)
+            keep[new_pos] = False
+
+            def weave(old_col, new_col, dtype):
+                out = np.empty(total, dtype)
+                out[keep] = old_col
+                out[new_pos] = new_col
+                return out
+
+            self._vis_mkey[d] = weave(old, mkey_s, np.int64)
+            self._vis_key[d] = weave(self._vis_key[d], key32, np.int32)
+            self._vis_op[d] = weave(self._vis_op[d], opcol, np.int64)
+            self._vis_action[d] = weave(self._vis_action[d], act32, np.int32)
+            # placeholders until the scoped readback refreshes these spans
+            self._vis_visible[d] = weave(self._vis_visible[d], False, bool)
+            self._vis_total[d] = weave(self._vis_total[d], 0, np.int64)
         if not self._vis_all_stale[d]:
-            self._vis_stale[d].update(np.unique(arr[:, 0]).tolist())
+            self._vis_stale[d].update(uniq.tolist())
 
     def _refresh_visibility(self, docs):
         """Brings the visibility cache of `docs` up to date: ONE batched
@@ -1661,6 +2203,90 @@ class TpuDocFarm:
             offset += n
             self._vis_all_stale[d] = False
             self._vis_stale[d].clear()
+
+    def _refresh_patch_columns(self, docs, col_cuts):
+        """The fused fast path of `_refresh_visibility`: one device program
+        (engine.read_patch_columns) refreshes the stale spans AND emits the
+        patch mask for this delivery's cutoff slots, so patch assembly
+        needs no host-side walk-order sort or visibility filter. Per
+        refreshed row the walk cutoff rides along as a rank-packed int64
+        (-1 = the row's slot is outside the delivery's cutoff set; int64
+        max = walk to the end of the key run). Returns {doc: (idx, emit)}
+        for the docs actually refreshed."""
+        plan = []
+        gathered = 0
+        live = 0
+        rank = self._actor_rank()
+        inf = np.iinfo(np.int64).max
+        for d in docs:
+            mkey = self._vis_mkey[d]
+            if mkey.shape[0] == 0:
+                self._vis_all_stale[d] = False
+                self._vis_stale[d].clear()
+                continue
+            live += mkey.shape[0]
+            if self._vis_all_stale[d]:
+                idx = np.arange(mkey.shape[0])
+            elif self._vis_stale[d]:
+                slots = np.fromiter(
+                    self._vis_stale[d], np.int64, len(self._vis_stale[d])
+                )
+                slots.sort()
+                _, _, idx, _ = ragged_spans(mkey, slots)
+            else:
+                # unreachable in practice (_merge_mirror just marked this
+                # delivery's slots stale), kept for interface symmetry
+                if _METRICS.enabled:
+                    _M_RB_HITS.inc(self._live_slot_count(d))
+                continue
+            if _METRICS.enabled:
+                fresh = self._live_slot_count(d) - (
+                    0 if self._vis_all_stale[d] else len(self._vis_stale[d])
+                )
+                _M_RB_HITS.inc(max(fresh, 0))
+            cut_slots, cut_packed = col_cuts[d]
+            keys = self._vis_key[d][idx].astype(np.int64)
+            pos = np.minimum(
+                np.searchsorted(cut_slots, keys), len(cut_slots) - 1
+            )
+            matched = cut_slots[pos] == keys
+            cp = cut_packed[pos]
+            # cached cutoffs pack the actor INDEX; the device compares
+            # lamport keys with actor RANK low bits — translate, keeping
+            # the walk-to-end sentinel intact (its index bits are clipped:
+            # np.where evaluates both branches)
+            ai = np.minimum(cp & ACTOR_MASK, len(rank) - 1)
+            cp = np.where(cp == inf, cp, (cp & ~ACTOR_MASK) | rank[ai])
+            cut = np.where(matched, cp, -1)
+            plan.append((d, idx, cut))
+            gathered += idx.shape[0]
+        if _METRICS.enabled:
+            _M_RB_ROWS.inc(gathered)
+            _M_RB_SKIPPED.inc(live - gathered)
+        if not plan:
+            return {}
+        readback_t0 = time.perf_counter()
+        visible, totals, emit = self.engine.read_patch_columns(
+            plan, actor_rank=rank
+        )
+        if _METRICS.enabled:
+            _M_READBACK_MS.observe(
+                (time.perf_counter() - readback_t0) * 1000.0,
+                exemplar=current_exemplar(),
+            )
+        out = {}
+        offset = 0
+        for d, idx, _cut in plan:
+            n = idx.shape[0]
+            self._vis_visible[d][idx] = visible[offset:offset + n]
+            self._vis_total[d][idx] = totals[offset:offset + n]
+            out[d] = (idx, emit[offset:offset + n])
+            offset += n
+            self._vis_all_stale[d] = False
+            self._vis_stale[d].clear()
+        if _METRICS.enabled:
+            _M_DEV_COLS.inc(int(emit.sum()))
+        return out
 
     def _live_slot_count(self, d):
         keys = self._vis_key[d]
@@ -1960,7 +2586,12 @@ class TpuDocFarm:
                         d, slot, (s, e), ops, tot, spec, walked, is_ctr
                     )
 
-        # link touched objects up to the root (setupPatches, new.js:1461)
+        self._link_ancestors(d, patches, touched_objects)
+        return patches["_root"]
+
+    def _link_ancestors(self, d, patches, touched_objects):
+        """Links touched objects up to the root (setupPatches, new.js:1461)
+        — shared tail of `_build_diffs` and `_build_diffs_columns`."""
         for object_id in sorted(touched_objects):
             meta = self.object_meta[d].get(object_id)
             if meta is None:
@@ -2000,6 +2631,64 @@ class TpuDocFarm:
                 object_id = meta["parentObj"]
                 meta = self.object_meta[d][object_id]
 
+    def _opid_str_cached(self, packed):
+        s = self._opid_strs.get(packed)
+        if s is None:
+            s = f"{packed >> ACTOR_BITS}@{self.actors.lookup(packed & ACTOR_MASK)}"
+            if len(self._opid_strs) < (1 << 16):
+                self._opid_strs[packed] = s
+        return s
+
+    def _leaf_diff(self, value_id):
+        """valueDiff for a plain (non-counter, non-ChildObj) interned value
+        — the only kind the device-column path can emit (its eligibility
+        gate excludes counter docs and farms with child values, making
+        this equivalent to `_value_diff`). Templates are cached per value
+        id and copied per emission (patch consumers may mutate them)."""
+        tpl = self._leaf_tpls.get(value_id)
+        if tpl is None:
+            cell = self.values.lookup(value_id)
+            tpl = {"type": "value", "value": cell.value}
+            if cell.datatype is not None:
+                tpl["datatype"] = cell.datatype
+            if len(self._leaf_tpls) < (1 << 16):
+                self._leaf_tpls[value_id] = tpl
+        return dict(tpl)
+
+    def _build_diffs_columns(self, d, idx, emit, cut_slots, touched_objects):
+        """Patch assembly from DEVICE-emitted patch columns — the fast path
+        for single-change columnar commits on counter-free, child-free
+        state. The emit mask arrived with the fused visibility readback
+        (engine.read_patch_columns), so the walk-order sort and the
+        visibility/action/cutoff filters of `_build_diffs` have already
+        happened on device; what remains is column -> JSON
+        materialisation."""
+        patches = {"_root": _empty_object_patch("_root", "map")}
+        eidx = idx[emit]
+        # mirror rows are merge-key (slot-major) ordered, so the emitted
+        # keys arrive pre-grouped for the span searchsorted below
+        keys = self._vis_key[d][eidx].astype(np.int64)
+        ops = self._vis_op[d][eidx]
+        tot = self._vis_total[d][eidx]
+        if _METRICS.enabled:
+            _M_VECTOR_ROWS.inc(int(idx.shape[0]))
+        lo = np.searchsorted(keys, cut_slots).tolist()
+        hi = np.searchsorted(keys, cut_slots + 1).tolist()
+        ops_l = ops.tolist()
+        tot_l = tot.tolist()
+        meta = self.object_meta[d]
+        opid_str = self._opid_str_cached
+        leaf = self._leaf_diff
+        for i, slot in enumerate(cut_slots.tolist()):
+            obj, key = self.slots.lookup(slot)
+            if obj not in meta:
+                continue
+            patch = self._ensure_patch(d, patches, obj)
+            # each walk resets the key's conflict map (new.js:1000)
+            props = patch["props"][key] = {}
+            for j in range(lo[i], hi[i]):
+                props[opid_str(ops_l[j])] = leaf(tot_l[j])
+        self._link_ancestors(d, patches, touched_objects)
         return patches["_root"]
 
     # ------------------------------------------------------------------ #
@@ -2117,6 +2806,8 @@ class TpuDocFarm:
         requested heads we lack (getMissingDeps, new.js:2006)."""
         missing = set()
         in_queue = {change["hash"] for change in self.queue[d]}
+        # amlint: disable=AM107 — sync-protocol API over the (small)
+        # undeliverable queue, not a throughput phase
         for change in self.queue[d]:
             for dep in change["deps"]:
                 if dep not in self.change_index_by_hash[d] and dep not in in_queue:

@@ -223,6 +223,26 @@ def paged_visible_ranked(slab: SlabState, gather_pages, actor_rank, *,
     return jax.vmap(_visible_state_one_doc)(key, op, action, value, pred, over, cmp)
 
 
+@jax.jit
+def patch_column_rows(visible, totals, op, actor_rank, idx, cut):
+    """Row gather + device patch emission for the scoped readback:
+    `visible`/`totals`/`op` are the paged visibility outputs
+    (paged_visible_ranked, ``[A_pad, W]``), `idx` flat ``doc * W + row``
+    indices host-padded to pow2, `cut` each row's walk cutoff as a
+    rank-packed int64 (``-1`` = outside the delivery's cutoff set — pad
+    rows never emit; int64 max = walk to the end of the key run). Returns
+    (visible, totals, emit) rows. Kept separate from the visibility
+    program on purpose: this gather's shape varies with the pow2 idx
+    bucket and compiles in milliseconds, while the expensive visibility
+    kernel keeps its one ``[A_pad, W]`` shape."""
+    from .rga import patch_emit_columns  # rga imports engine: bind lazily
+
+    v = visible.reshape(-1)[idx]
+    t = totals.reshape(-1)[idx]
+    lam = remap_opid_actors(op.reshape(-1)[idx], actor_rank)
+    return v, t, patch_emit_columns(v, lam, cut)
+
+
 @partial(jax.jit, static_argnames=("page_size",))
 def paged_dense_view(slab: SlabState, gather_pages, *, page_size: int):
     """Dense [D, W] gather of all six columns (parity/debug readback)."""

@@ -166,3 +166,15 @@ def batched_rga_rank(parent, opid, valid, actor_rank):
         )
     remapped = remap_opid_actors(opid, actor_rank)
     return jax.vmap(_rga_rank_one_doc)(parent, remapped, valid)
+
+
+def patch_emit_columns(visible, lam, cut):
+    """Device-side patch-emit mask: a gathered row lands in the patch iff
+    it is visible (visibility implies a live SET row — DEL/INC rows never
+    win) and its rank-remapped lamport key is within its slot's walk
+    cutoff. ``cut`` carries the cutoff per gathered row as an int64:
+    ``-1`` = the row's slot is outside this delivery's cutoff set, int64
+    max = walk to the end of the key run (the farm's +inf sentinel).
+    Traced inside paging.patch_column_rows, so the row readback and the
+    emit decision are one device program."""
+    return visible & (lam <= cut) & (cut >= 0)

@@ -147,6 +147,61 @@ def ragged_spans(sorted_mkey, slots):
     return lo, counts, idx, grp
 
 
+#: gate_verdicts dep-column sentinels: a dep that is already committed in
+#: the doc, and a dep that is neither committed nor in this delivery.
+DEP_COMMITTED = -1
+DEP_UNKNOWN = -2
+
+
+def gate_verdicts(dep_idx, dep_counts):
+    """Causal-gate verdicts for a whole delivery as one column program.
+
+    ``dep_counts[i]`` is the number of deps of delivery entry ``i`` (entries
+    are one doc's pending changes in arrival order); ``dep_idx`` is the flat
+    int64 dep column — for each dep either the global entry index of the
+    in-delivery change it names, ``DEP_COMMITTED`` for a dep already in the
+    doc's change index, or ``DEP_UNKNOWN`` for a dep nobody has seen.
+
+    Returns the int64 ``batch`` column: 0 = deferred (some dep chain ends in
+    an unknown hash), else the 1-based gate round the entry commits in —
+    exactly the round ``_gate_round`` would admit it, because the scalar
+    gate scans pending in order and counts a same-round *earlier* entry as
+    satisfied: ``batch[c] = max(1, max over deps d of
+    (batch[d] + (d > c)))`` with committed deps contributing 1.
+
+    The relaxation is a fixpoint sweep: batches only grow and the deferred
+    set only grows among reachable entries, so ``n + 1`` sweeps always
+    converge (each sweep settles at least one more entry of the longest
+    dep chain)."""
+    dep_idx = np.asarray(dep_idx, dtype=np.int64)
+    dep_counts = np.asarray(dep_counts, dtype=np.int64)
+    n = dep_counts.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    owner = np.repeat(np.arange(n, dtype=np.int64), dep_counts)
+    in_delivery = dep_idx >= 0
+    unknown = dep_idx == DEP_UNKNOWN
+    same_round_ok = dep_idx < owner  # earlier entry satisfies in-round
+    batch = np.ones(n, dtype=np.int64)
+    for _ in range(n + 1):
+        target = batch[np.maximum(dep_idx, 0)]
+        dep_batch = np.where(
+            in_delivery,
+            target + np.where(same_round_ok, 0, 1),
+            1,  # DEP_COMMITTED; DEP_UNKNOWN is masked out via `bad` below
+        )
+        bad_dep = unknown | (in_delivery & (target == 0))
+        new = np.ones(n, dtype=np.int64)
+        np.maximum.at(new, owner, dep_batch)
+        bad = np.zeros(n, dtype=bool)
+        np.logical_or.at(bad, owner, bad_dep)
+        new[bad] = 0
+        if np.array_equal(new, batch):
+            break
+        batch = new
+    return batch
+
+
 class BatchTranscoder:
     """Interns actors/(object, key) slots/values for one document batch and
     packs change ops into ChangeOpsBatch tensors."""

@@ -344,6 +344,12 @@ def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
         for name, entry in prof.as_dict().items()
     }
     tail = phases.get("visibility", 0.0) + phases.get("patch_assembly", 0.0)
+    gate = (
+        phases.get("gate_verdicts", 0.0)
+        + phases.get("transcode_columns", 0.0)
+        + phases.get("gate+transcode", 0.0)
+        + phases.get("patch_assembly", 0.0)
+    )
     denom = sum(phases.values()) or 1.0
     snap = metrics.as_dict()
 
@@ -356,31 +362,126 @@ def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
         "phases": phases,
         "tail_s": round(tail, 4),
         "tail_share": round(tail / denom, 4),
+        "gate_s": round(gate, 4),
+        "gate_share": round(gate / denom, 4),
         "readback_rows": _value("farm.readback.rows"),
         "readback_rows_skipped": _value("farm.readback.rows_skipped"),
+        "vector_changes": _value("farm.gate.vector_changes"),
+        "gate_oracle_docs": _value("farm.gate.oracle_docs"),
+        "transcode_oracle_docs": _value("farm.transcode.oracle_docs"),
+        "device_patch_columns": _value("farm.patch.device_columns"),
         "decode_cache_hits": _value("codecs.decode_cache.hits"),
         "decode_cache_misses": _value("codecs.decode_cache.misses"),
     }
 
 
+def bench_gate(num_docs=256, rounds=6, ops_per_round=32, seed=0):
+    """Gate-phase microbench (`make gate-bench`): the same delivery
+    stream through a columnar-gate farm and a ``gate_mode="oracle"``
+    farm, comparing the host gate trio (gate_verdicts + transcode_columns
+    + gate+transcode) plus patch_assembly. The oracle run doubles as a
+    parity check: both farms must produce canonically identical final
+    patches."""
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.profiling import PhaseProfile, use_profile
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    buffers = _make_change_stream(rounds, ops_per_round, seed)
+    capacity = rounds * ops_per_round + 8
+    out = {}
+    finals = {}
+    for mode in ("columnar", "oracle"):
+        farm = TpuDocFarm(num_docs, capacity=capacity, gate_mode=mode)
+        warm = TpuDocFarm(num_docs, capacity=capacity, gate_mode=mode)
+        warm.apply_changes([[buffers[0]]] * num_docs)
+        metrics = get_metrics()
+        metrics.reset()
+        prof = PhaseProfile()
+        start = time.perf_counter()
+        last = None
+        with use_profile(prof), enabled_metrics():
+            for buf in buffers:
+                last = farm.apply_changes([[buf]] * num_docs)
+        elapsed = time.perf_counter() - start
+        phases = {
+            name: round(entry["total_s"], 4)
+            for name, entry in prof.as_dict().items()
+        }
+        gate_s = (
+            phases.get("gate_verdicts", 0.0)
+            + phases.get("transcode_columns", 0.0)
+            + phases.get("gate+transcode", 0.0)
+            + phases.get("patch_assembly", 0.0)
+        )
+        snap = metrics.as_dict()
+        finals[mode] = json.dumps(last, sort_keys=True)
+        out[mode] = {
+            "ops_per_sec": round(num_docs * rounds * ops_per_round / elapsed),
+            "gate_s": round(gate_s, 4),
+            "phases": phases,
+            "vector_changes": snap.get(
+                "farm.gate.vector_changes", {}
+            ).get("value", 0),
+        }
+    out["parity"] = finals["columnar"] == finals["oracle"]
+    out["gate_speedup"] = round(
+        out["oracle"]["gate_s"] / max(out["columnar"]["gate_s"], 1e-9), 2
+    )
+    return out
+
+
+def _gate_main():
+    """`bench.py --gate`: the gate-phase microbench. Exit 1 when the
+    columnar/oracle patches diverge or the columnar gate stops being
+    faster than the scalar chain."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    num_docs = int(os.environ.get("BENCH_GATE_DOCS", "256"))
+    rounds = int(os.environ.get("BENCH_GATE_ROUNDS", "6"))
+    ops = int(os.environ.get("BENCH_GATE_OPS", "32"))
+    result = bench_gate(num_docs, rounds, ops)
+    ok = result["parity"] and result["gate_speedup"] > 1.0
+    print(json.dumps({
+        "metric": "gate-phase host time, columnar vs scalar oracle",
+        "value": result["gate_speedup"],
+        "unit": "x speedup",
+        "parity": result["parity"],
+        "ok": ok,
+        "columnar": result["columnar"],
+        "oracle": result["oracle"],
+    }))
+    sys.exit(0 if ok else 1)
+
+
 def _quick_main():
     """`bench.py --quick`: the CPU smoke gate. One JSON line; exit 1 when
-    the visibility+patch_assembly share exceeds the pinned threshold or
-    the scoped readback stops being incremental."""
+    the visibility+patch_assembly share or the gate+assembly share
+    (gate_verdicts + transcode_columns + gate+transcode + patch_assembly
+    — the phases the columnar gate retired from host Python) exceeds its
+    pinned threshold, or the scoped readback stops being incremental."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # host gate: no TPU needed
     num_docs = int(os.environ.get("BENCH_SMOKE_DOCS", "128"))
     threshold = float(os.environ.get("BENCH_SMOKE_MAX_TAIL_SHARE", "0.55"))
+    gate_max = float(os.environ.get("BENCH_SMOKE_MAX_GATE_SHARE", "0.45"))
     result = bench_smoke(num_docs)
     incremental = result["readback_rows_skipped"] > result["readback_rows"]
-    ok = result["tail_share"] <= threshold and incremental
+    ok = (
+        result["tail_share"] <= threshold
+        and result["gate_share"] <= gate_max
+        and incremental
+    )
     print(json.dumps({
         "metric": "visibility+patch_assembly share of delta-round time",
         "value": result["tail_share"],
         "unit": "share",
         "threshold": threshold,
+        "gate_share": result["gate_share"],
+        "gate_threshold": gate_max,
         "incremental_readback": incremental,
         "readback_rows": result["readback_rows"],
         "readback_rows_skipped": result["readback_rows_skipped"],
+        "vector_changes": result["vector_changes"],
+        "gate_oracle_docs": result["gate_oracle_docs"],
+        "device_patch_columns": result["device_patch_columns"],
         "ok": ok,
         "ops_per_sec": round(result["ops_per_sec"]),
         "phases_s": result["phases"],
@@ -1125,6 +1226,8 @@ if __name__ == "__main__":
         _decode_main()
     elif "--serve" in sys.argv:
         _serve_main(quick="--quick" in sys.argv)
+    elif "--gate" in sys.argv:
+        _gate_main()
     elif "--quick" in sys.argv:
         _quick_main()
     elif "--faults" in sys.argv:

@@ -1,0 +1,11 @@
+# amlint: hot-path — fixture: justified suppressions silence AM107
+
+
+def oracle_gate(pending, committed):
+    """A deliberate scalar oracle kept next to the columnar gate."""
+    applied = []
+    # amlint: disable=AM107 — scalar parity oracle: owns the canonical error
+    for change in pending:
+        if all(dep in committed for dep in change["deps"]):
+            applied.append(change)
+    return applied

@@ -1,0 +1,24 @@
+# amlint: hot-path — fixture: per-change/per-op gate loops (AM107)
+
+
+def gate_round(pending, committed):
+    """The scalar causal-gate shape: one Python iteration per change."""
+    applied = []
+    for change in pending:
+        if all(dep in committed for dep in change["deps"]):
+            applied.append(change)
+    return applied
+
+
+def transcode(change, rows):
+    """The scalar transcode shape: one Python iteration per op."""
+    for op in change["ops"]:
+        rows.append((op["action"], op["key"]))
+    return rows
+
+
+def drain(applied_ops):
+    seen = []
+    for entry in applied_ops:
+        seen.append(entry)
+    return seen

@@ -21,6 +21,12 @@ import bench
 # O(whole farm) per call)
 MAX_TAIL_SHARE = 0.55
 
+# gate_verdicts + transcode_columns + gate+transcode + patch_assembly:
+# the phases the columnar causal gate + device-emitted patch columns
+# retired from per-change host Python (BENCH_r07 measures ~0.03 at the
+# delta config; a revert to the scalar chain pushes this past 0.5)
+MAX_GATE_SHARE = 0.45
+
 _RESULT = None
 
 
@@ -43,6 +49,30 @@ def test_visibility_assembly_share_stays_bounded():
         f"readback / vectorized assembly path has regressed; phases: "
         f"{result['phases']}"
     )
+
+
+def test_gate_assembly_share_stays_bounded():
+    """The columnar-gate regression signature: per-change Python creeping
+    back into gate/transcode or patch assembly drags their combined share
+    of the delta-round time back toward the scalar chain's profile."""
+    result = _smoke()
+    assert result["gate_share"] <= MAX_GATE_SHARE, (
+        f"gate+transcode+patch_assembly is {result['gate_share']:.0%} of "
+        f"the delta-round time (limit {MAX_GATE_SHARE:.0%}): the columnar "
+        f"gate / device patch-column path has regressed; phases: "
+        f"{result['phases']}"
+    )
+
+
+def test_gate_is_columnar_with_device_patch_columns():
+    """Machine-independent row-count properties: deliveries ride the
+    columnar verdict path (no oracle re-routes on a clean workload) and
+    patch emission happens on device."""
+    result = _smoke()
+    assert result["vector_changes"] > 0, result
+    assert result["gate_oracle_docs"] == 0, result
+    assert result["transcode_oracle_docs"] == 0, result
+    assert result["device_patch_columns"] > 0, result
 
 
 def test_readback_is_incremental():

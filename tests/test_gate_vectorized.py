@@ -1,0 +1,359 @@
+"""Parity suite for the columnar causal gate + device-emitted patch
+columns (ISSUE: retire the last host-Python hot phases).
+
+The columnar gate computes whole-delivery commit verdicts from dep-index
+columns (`transcode.gate_verdicts`), commits changes from cached column
+blocks, and takes patch-emit verdicts from the device readback
+(`rga.patch_emit_columns`). The scalar gate + sequential OpSet walk stay
+in-tree as the parity oracle — `gate_mode="oracle"` pins every doc to
+them. This suite asserts the two chains are indistinguishable: every
+patch BYTE-IDENTICAL (canonical JSON, stricter than dict equality)
+across fuzz workloads, the poisoned-byte corpus with quarantine/rollback
+interleavings, mid-gate deferrals, device-fault fallback, and anomaly
+re-routes — and that a re-routed doc leaves metrics and host caches in
+the same state as a scalar-only run.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+from automerge_tpu.opset import OpSet
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+from test_farm import Workload, make_change
+
+SEEDS = [11, 23, 47]
+ROUNDS = 10
+
+
+def canon(patch):
+    return json.dumps(patch, sort_keys=True)
+
+
+def make_farms(num_docs, capacity=64):
+    return (
+        TpuDocFarm(num_docs, capacity=capacity, quarantine_threshold=None,
+                   gate_mode="columnar"),
+        TpuDocFarm(num_docs, capacity=capacity, quarantine_threshold=None,
+                   gate_mode="oracle"),
+    )
+
+
+def set_change(actor, seq, start_op, deps, key, value, pred=()):
+    ops = [{"action": "set", "obj": "_root", "key": key,
+            "datatype": "uint", "value": value, "pred": list(pred)}]
+    return make_change(actor, seq, start_op, deps, ops)
+
+
+def assert_farm_state_equal(columnar, oracle, context=""):
+    """The observable state the two gate chains must agree on."""
+    for d in range(columnar.num_docs):
+        assert columnar.get_heads(d) == oracle.get_heads(d), (context, d)
+        assert columnar.get_missing_deps(d) == oracle.get_missing_deps(d), (
+            context, d,
+        )
+        assert canon(columnar.get_patch(d)) == canon(oracle.get_patch(d)), (
+            f"{context}: whole-doc patch diverged for doc {d}"
+        )
+
+
+def run_differential(seed, num_docs=3, rounds=ROUNDS, deliver=None,
+                     with_oracle_walk=True):
+    """One workload through a columnar farm, an oracle farm and per-doc
+    OpSet walks, asserting canonical patch equality per delivery."""
+    columnar, oracle = make_farms(num_docs)
+    walks = [OpSet() for _ in range(num_docs)]
+    workload = Workload(seed)
+    for r in range(rounds):
+        buffers = workload.next_round(walks[0])
+        if not buffers:
+            continue
+        per_doc = [list(buffers) for _ in range(num_docs)]
+        if deliver is not None:
+            per_doc = deliver(r, per_doc)
+        got_c = columnar.apply_changes([list(b) for b in per_doc])
+        got_o = oracle.apply_changes([list(b) for b in per_doc])
+        for d in range(num_docs):
+            assert canon(got_c[d]) == canon(got_o[d]), (
+                f"seed={seed} round={r} doc={d}: columnar diverged from "
+                f"the scalar gate\ngot:  {canon(got_c[d])}\n"
+                f"want: {canon(got_o[d])}"
+            )
+            if with_oracle_walk:
+                want = walks[d].apply_changes(list(per_doc[d]))
+                assert canon(got_c[d]) == canon(want), (
+                    f"seed={seed} round={r} doc={d}: diverged from OpSet"
+                )
+    assert_farm_state_equal(columnar, oracle, f"seed={seed}")
+    if with_oracle_walk:
+        for d in range(num_docs):
+            assert canon(columnar.get_patch(d)) == canon(
+                walks[d].get_patch()
+            )
+    return columnar, oracle
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_corpus_gate_parity(seed):
+    """Random map-family workloads (concurrent actors, counters, nesting,
+    deletes, delayed delivery): columnar gate ≡ scalar gate ≡ OpSet."""
+    run_differential(seed)
+
+
+@pytest.mark.parametrize("name,corrupt,kind", faults.BYTE_CORPUS,
+                         ids=[c[0] for c in faults.BYTE_CORPUS])
+def test_byte_corpus_quarantine_parity(name, corrupt, kind):
+    """A poisoned delivery mid-stream quarantines/rolls back identically
+    on both gate chains, and every subsequent clean delivery stays
+    byte-identical (a stale mirror after a columnar rollback would
+    diverge here)."""
+    poison_round, poison_doc = 3, 1
+
+    def deliver(r, per_doc):
+        if r == poison_round and per_doc[poison_doc]:
+            per_doc[poison_doc] = [
+                bytes(corrupt(buf)) for buf in per_doc[poison_doc]
+            ]
+        return per_doc
+
+    # corrupted buffers diverge from the OpSet contract (the walk raises
+    # where the farm quarantines), so compare the two farms only
+    run_differential(7, deliver=deliver, with_oracle_walk=False)
+
+
+def test_mid_gate_deferral_ready_next_delivery():
+    """A change whose dep is still unknown gets verdict 0 (deferred,
+    queued); the next delivery carrying the dep commits BOTH in causal
+    order — on each chain, with identical patches at each step."""
+    buf_a, h_a = set_change("aaaaaaaa", 1, 1, [], "x", 1)
+    buf_b, _h_b = set_change("aaaaaaaa", 2, 2, [h_a], "x", 2,
+                             pred=["1@aaaaaaaa"])
+    columnar, oracle = make_farms(1)
+    walk = OpSet()
+
+    want_defer = walk.apply_changes([buf_b])
+    (got_c,) = columnar.apply_changes([[buf_b]])
+    (got_o,) = oracle.apply_changes([[buf_b]])
+    assert canon(got_c) == canon(got_o) == canon(want_defer)
+    assert columnar.get_missing_deps(0) == [h_a]
+
+    want_both = walk.apply_changes([buf_a])
+    (got_c,) = columnar.apply_changes([[buf_a]])
+    (got_o,) = oracle.apply_changes([[buf_a]])
+    assert canon(got_c) == canon(got_o) == canon(want_both)
+    assert columnar.get_missing_deps(0) == []
+    assert_farm_state_equal(columnar, oracle, "deferral")
+
+
+def test_deferral_across_interleaved_deliveries():
+    """Partial deferral: one ready change commits while its delivery-mate
+    stays queued; parity holds through the delivery that releases it."""
+    buf_a, h_a = set_change("aaaaaaaa", 1, 1, [], "x", 1)
+    buf_b, h_b = set_change("bbbbbbbb", 1, 2, [h_a], "y", 2)
+    buf_c, _ = set_change("bbbbbbbb", 2, 3, [h_b], "y", 3,
+                          pred=["2@bbbbbbbb"])
+    columnar, oracle = make_farms(1)
+    walk = OpSet()
+    for delivery in ([buf_b, buf_c], [buf_a]):
+        want = walk.apply_changes(list(delivery))
+        (got_c,) = columnar.apply_changes([list(delivery)])
+        (got_o,) = oracle.apply_changes([list(delivery)])
+        assert canon(got_c) == canon(got_o) == canon(want)
+    assert_farm_state_equal(columnar, oracle, "partial deferral")
+
+
+def test_device_fault_fallback_parity():
+    """The device path failing for one doc mid-dispatch must degrade to
+    the sequential walk with identical patches on both chains, and the
+    doc must rejoin the device path cleanly afterwards."""
+    seed = 13
+
+    def run(mode):
+        farm = TpuDocFarm(3, capacity=64, quarantine_threshold=None,
+                          gate_mode=mode)
+        walks = [OpSet() for _ in range(3)]
+        workload = Workload(seed)
+        out = []
+        for r in range(ROUNDS):
+            buffers = workload.next_round(walks[0])
+            if not buffers:
+                continue
+            per_doc = [list(buffers) for _ in range(3)]
+            if r == 4:
+                with faults.inject("farm.device_dispatch",
+                                   faults.fail_docs([2])):
+                    patches = farm.apply_changes(per_doc)
+            else:
+                patches = farm.apply_changes(per_doc)
+            out.append([canon(p) for p in patches])
+        out.append([canon(farm.get_patch(d)) for d in range(3)])
+        return out
+
+    assert run("columnar") == run("oracle")
+
+
+def _metric_state(reg):
+    """Metric snapshot minus the chain-routing counters themselves (the
+    columnar run legitimately counts its own re-routes) and the counters
+    that track process-global caches (decode LRU, jit cache), whose
+    hit/miss split depends on which run went first."""
+    skip = {
+        "farm.gate.vector_changes", "farm.gate.oracle_docs",
+        "farm.transcode.oracle_docs", "farm.patch.device_columns",
+    }
+    out = {}
+    for name, snap in reg.as_dict().items():
+        if name in skip or snap["type"] == "histogram":
+            continue
+        if "decode" in name or "jit" in name or name.startswith("codecs."):
+            continue
+        out[name] = snap["value"]
+    return out
+
+
+def _cache_state(farm):
+    """The host caches whose staleness would silently corrupt later
+    patches: the row mirror and the visibility cache."""
+    state = []
+    for d in range(farm.num_docs):
+        state.append((
+            farm._vis_mkey[d].tolist(),
+            farm._vis_visible[d].tolist(),
+            farm._vis_total[d].tolist(),
+            sorted(farm._vis_stale[d]),
+            bool(farm._vis_all_stale[d]),
+            [c["hash"] for c in farm.queue[d]],
+        ))
+    return state
+
+
+def test_oracle_reroute_matches_scalar_only_run():
+    """An in-delivery duplicate hash re-routes the doc through the scalar
+    chain pre-verdict; the re-routed run must leave patches, metrics and
+    host caches in the SAME state as a farm pinned to the scalar chain
+    for the whole run."""
+    buf_a, h_a = set_change("aaaaaaaa", 1, 1, [], "x", 1)
+    buf_b, _ = set_change("aaaaaaaa", 2, 2, [h_a], "y", 2)
+
+    def run(mode):
+        reg = get_metrics()
+        reg.reset()
+        with enabled_metrics():
+            farm = TpuDocFarm(1, capacity=32, quarantine_threshold=None,
+                              gate_mode=mode)
+            (p1,) = farm.apply_changes([[buf_a]])
+            # duplicate within ONE delivery: the oracle owns dedup order
+            (p2,) = farm.apply_changes([[buf_b, buf_b]])
+        return farm, [canon(p1), canon(p2)], _metric_state(reg)
+
+    farm_c, patches_c, metrics_c = run("columnar")
+    farm_o, patches_o, metrics_o = run("oracle")
+    assert patches_c == patches_o
+    assert metrics_c == metrics_o
+    assert _cache_state(farm_c) == _cache_state(farm_o)
+    assert_farm_state_equal(farm_c, farm_o, "dup re-route")
+
+
+def test_seq_anomaly_reroutes_to_canonical_error():
+    """A seq-contiguity violation fails columnar commit validation and
+    re-routes pre-mutation: the scalar chain raises the canonical
+    CausalityError, and both chains quarantine identically."""
+    buf_a, h_a = set_change("aaaaaaaa", 1, 1, [], "x", 1)
+    # seq jumps 1 -> 3: causally impossible, deps satisfied
+    buf_bad, _ = set_change("aaaaaaaa", 3, 2, [h_a], "y", 2)
+    columnar, oracle = make_farms(1)
+    for farm in (columnar, oracle):
+        farm.apply_changes([[buf_a]])
+        result = farm.apply_changes([[buf_bad]])
+        (outcome,) = result.outcomes
+        assert outcome.status == "quarantined"
+        assert outcome.error_kind == "causality"
+    assert_farm_state_equal(columnar, oracle, "seq anomaly")
+
+
+def test_reroute_then_columnar_again():
+    """A doc that re-routed through the oracle one delivery must ride the
+    columnar path again on the next clean delivery, with parity."""
+    buf_a, h_a = set_change("aaaaaaaa", 1, 1, [], "x", 1)
+    buf_b, h_b = set_change("aaaaaaaa", 2, 2, [h_a], "y", 2)
+    buf_c, _ = set_change("aaaaaaaa", 3, 3, [h_b], "z", 3)
+    columnar, oracle = make_farms(1)
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        for delivery in ([buf_a, buf_a], [buf_b], [buf_c]):
+            (got_c,) = columnar.apply_changes([list(delivery)])
+            (got_o,) = oracle.apply_changes([list(delivery)])
+            assert canon(got_c) == canon(got_o)
+    snap = reg.as_dict()
+    assert snap["farm.gate.oracle_docs"]["value"] == 1  # the dup delivery
+    assert snap["farm.gate.vector_changes"]["value"] == 2  # b and c
+    assert_farm_state_equal(columnar, oracle, "re-route recovery")
+
+
+def test_rollback_scopes_mirror_invalidation():
+    """Regression (satellite): `_restore_doc` must invalidate only the
+    spans the failed delivery actually touched — not the whole doc. The
+    recovery delivery's scoped readback transfers rows for the touched
+    slots only, pinned via farm.readback.rows."""
+    # doc 1 rides along healthy so the dispatch-fault bisect convicts doc
+    # 0 instead of declaring the device itself down (which would serve
+    # everyone through the fallback walk, no rollback)
+    farm = TpuDocFarm(2, capacity=64, quarantine_threshold=None)
+    walk = OpSet()
+    # six committed rounds -> six live single-row slots, mirror warm
+    deps, seq, start = [], 1, 1
+    for r in range(6):
+        buf, h = set_change("aaaaaaaa", seq, start, deps, f"k{r}", r)
+        farm.apply_changes([[buf], [buf]])
+        walk.apply_changes([buf])
+        deps, seq, start = [h], seq + 1, start + 1
+    reg = get_metrics()
+    reg.reset()
+    with enabled_metrics():
+        # a delivery that transcodes k6 rows, then dies at dispatch:
+        # the quarantine rollback must mark ONLY k6's slot stale
+        buf_bad, _ = set_change("aaaaaaaa", seq, start, deps, "k6", 99)
+        with faults.inject("farm.device_dispatch", faults.fail_docs([0])):
+            result = farm.apply_changes([[buf_bad], [buf_bad]])
+        assert result.outcomes[0].status == "quarantined"
+        reg.reset()  # count the RECOVERY delivery's readback only
+        # recovery: a clean delivery touching one NEW slot (k7)
+        buf_ok, _ = set_change("aaaaaaaa", seq, start, deps, "k7", 7)
+        got = farm.apply_changes([[buf_ok], []])[0]
+    want = walk.apply_changes([buf_ok])
+    assert canon(got) == canon(want)
+    rows = reg.as_dict()["farm.readback.rows"]["value"]
+    # k6's slot re-reads empty (rolled back), k7 contributes its one new
+    # row: whole-doc invalidation would re-read all seven live rows here
+    assert rows <= 2, (
+        f"scoped rollback invalidation regressed: the recovery readback "
+        f"transferred {rows} rows (whole-doc would be ~7)"
+    )
+    # the untouched slots' cached visibility still serves get_patch
+    assert canon(farm.get_patch(0)) == canon(walk.get_patch())
+
+
+def test_gate_verdict_columns_order_matches_append_order():
+    """Commit order from the verdict columns (stable argsort of batch
+    numbers) must equal the scalar gate's append order for a dep chain
+    delivered shuffled in one delivery."""
+    bufs, deps, hashes = [], [], []
+    seq, start = 1, 1
+    for i in range(5):
+        buf, h = set_change("aaaaaaaa", seq, start, deps, "x", i,
+                            pred=[f"{start - 1}@aaaaaaaa"] if i else ())
+        bufs.append(buf)
+        deps, seq, start = [h], seq + 1, start + 1
+        hashes.append(h)
+    shuffled = [bufs[3], bufs[0], bufs[4], bufs[2], bufs[1]]
+    columnar, oracle = make_farms(1)
+    walk = OpSet()
+    want = walk.apply_changes(list(shuffled))
+    (got_c,) = columnar.apply_changes([list(shuffled)])
+    (got_o,) = oracle.apply_changes([list(shuffled)])
+    assert canon(got_c) == canon(got_o) == canon(want)
+    assert columnar.get_heads(0) == oracle.get_heads(0) == [hashes[-1]]

@@ -429,7 +429,8 @@ def test_farm_phases_flow_through_the_shim():
     with use_profile(prof):
         farm.apply_changes([[buf], [buf]])
     d = prof.as_dict()
-    for phase in ("decode", "gate+transcode", "pack", "device_dispatch",
+    for phase in ("decode", "gate_verdicts", "transcode_columns",
+                  "gate+transcode", "pack", "device_dispatch",
                   "visibility", "patch_assembly"):
         assert phase in d, phase
         assert d[phase]["calls"] == 1
