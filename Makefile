@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh
+.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -63,6 +63,15 @@ serve:
 # test (tests/test_mesh_smoke.py)
 mesh:
 	$(PY) bench.py --mesh --quick
+
+# process-worker mesh smoke (README "Process workers"): the same quick
+# gates with every shard in its own spawned worker process — pickled
+# column fan-out, migration over the pipe, clean worker shutdown. The
+# full MULTICHIP_r08 record run: `python bench.py --mesh --backend
+# process`; byte parity + crash recovery are tier-1
+# (tests/test_mesh_workers_smoke.py, tests/test_mesh_workers.py)
+mesh-workers:
+	$(PY) bench.py --mesh --quick --backend process
 
 native:
 	$(MAKE) -C native

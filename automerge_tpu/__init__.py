@@ -28,6 +28,7 @@ from .errors import (
     RetryExhaustedError,
     SyncFrameError,
     SyncProtocolError,
+    WorkerCrashError,
 )
 from .sync import decode_sync_state, encode_sync_state
 from .sync_session import BackendDriver, SessionConfig, SyncSession
@@ -68,7 +69,7 @@ __all__ = [
     "AutomergeError", "DecodeError", "ChecksumError", "EncodeError",
     "CausalityError", "PackingLimitError", "SyncProtocolError",
     "SyncFrameError", "RetryExhaustedError", "ChannelQuarantinedError",
-    "QuarantinedError", "DeviceFaultError",
+    "QuarantinedError", "DeviceFaultError", "WorkerCrashError",
     "AdmissionRejectedError", "BackpressureError",
 ]
 

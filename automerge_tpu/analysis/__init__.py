@@ -41,7 +41,9 @@ Rule families (see core.RULES for the catalog):
 - **AM5xx mesh**: dense per-doc ``range()`` statement loops in the mesh
   controller's routing/merge-result paths — sparse active lists and
   comprehensions keep per-delivery Python O(active), not O(farm)
-  (AM501).
+  (AM501); worker-executed modules importing the controller layer or
+  touching process-global registry accessors — workers speak the pipe
+  protocol and ship metric deltas explicitly (AM502).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -55,7 +57,7 @@ import tokenize
 from pathlib import Path
 
 from . import (boundary, catalog, hotpath, meshrules, obsrules, packing,
-               taxonomy, tracer)
+               taxonomy, tracer, workerrules)
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -88,7 +90,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
     for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
-                   hotpath, meshrules):
+                   hotpath, meshrules, workerrules):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

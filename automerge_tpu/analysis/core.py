@@ -81,6 +81,11 @@ RULES: dict[str, tuple[str, str]] = {
                       "in a mesh routing/merge-result path (build sparse "
                       "active lists with comprehensions or vectorize with "
                       "numpy)"),
+    "AM502": ("mesh", "worker-executed module imports the mesh controller "
+                      "layer (meshfarm/serve) or touches a process-global "
+                      "registry accessor (get_metrics/get_flight/...) — "
+                      "workers speak the pipe protocol and record into "
+                      "explicitly shipped sinks"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -1,0 +1,127 @@
+"""AM502 — mesh worker hygiene: no controller imports, no process-global
+registry access in worker-executed modules.
+
+A mesh worker (parallel/workers.py) is spawned — not forked — so the
+child re-imports its module tree under a pristine interpreter. Two bug
+classes break that isolation and both have bitten multi-process serving
+stacks:
+
+1. **Controller imports.** A worker module that imports the controller
+   layer (``parallel/meshfarm.py`` or anything under ``serve/``) drags
+   the whole fan-in/routing machinery — and, transitively, its inline
+   thread pool and env mutation — into every spawned child. Beyond the
+   startup cost, it invites the worker to call controller entry points
+   that assume they own the routing arrays, turning a one-directional
+   pipe protocol into shared-state spaghetti.
+2. **Process-global registry access.** ``get_metrics()``/``get_flight()``
+   and friends hand back *per-process* singletons. Code written for the
+   controller that reaches for them from a worker silently records into
+   the child's registry and the numbers never surface — the classic
+   "metrics vanish under the process backend" failure. Worker code must
+   either receive its sinks explicitly or, where it deliberately uses
+   the worker-process singleton as the shipping buffer (the one blessed
+   pattern: record locally, ship ``diff_frames`` deltas over the pipe),
+   carry a justified suppression saying so.
+
+Flagged in scope:
+
+- ``import``/``from ... import`` whose module path contains a
+  controller-only segment (``meshfarm`` or ``serve``), or that imports
+  such a module by name from a package;
+- importing or calling a process-global registry accessor
+  (``get_metrics``, ``get_flight``, ``get_amscope``, ``get_trace``,
+  ``get_profile``).
+
+Scope: modules whose filename stem is in ``WORKER_STEMS``, plus any file
+carrying a ``# amlint: mesh-worker`` marker (the fixture hook, and the
+opt-in for future worker-executed modules living elsewhere).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import FileContext, Finding, dotted_name
+
+#: modules whose code executes inside spawned mesh worker processes
+WORKER_STEMS = frozenset({"workers"})
+
+_MARKER_RE = re.compile(r"#\s*amlint:\s*mesh-worker\b")
+
+#: module-path segments that mark a controller-only import
+CONTROLLER_SEGMENTS = frozenset({"meshfarm", "serve"})
+
+#: process-global registry accessors (obs + profiling singletons)
+GLOBAL_ACCESSORS = frozenset({
+    "get_metrics", "get_flight", "get_amscope", "get_trace", "get_profile",
+})
+
+
+def _in_scope(ctx: FileContext) -> bool:
+    return (
+        Path(ctx.path).stem in WORKER_STEMS
+        or _MARKER_RE.search(ctx.source) is not None
+    )
+
+
+def _controller_import(node: ast.AST) -> bool:
+    if isinstance(node, ast.Import):
+        return any(
+            CONTROLLER_SEGMENTS & set(alias.name.split("."))
+            for alias in node.names
+        )
+    if isinstance(node, ast.ImportFrom):
+        if CONTROLLER_SEGMENTS & set((node.module or "").split(".")):
+            return True
+        # `from . import meshfarm` / `from ..serve import batcher` style
+        return any(alias.name in CONTROLLER_SEGMENTS for alias in node.names)
+    return False
+
+
+def _imported_accessors(node: ast.AST) -> set[str]:
+    if isinstance(node, ast.ImportFrom):
+        return GLOBAL_ACCESSORS & {alias.name for alias in node.names}
+    return set()
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        if not _in_scope(ctx):
+            continue
+        for node in ast.walk(ctx.tree):
+            if _controller_import(node):
+                findings.append(ctx.finding(
+                    "AM502", node,
+                    "worker-executed module imports the mesh controller "
+                    "layer (meshfarm/serve): workers speak the pipe "
+                    "protocol only — the controller owns routing, fan-in "
+                    "and respawn policy",
+                ))
+                continue
+            imported = _imported_accessors(node)
+            if imported:
+                findings.append(ctx.finding(
+                    "AM502", node,
+                    f"worker-executed module imports process-global "
+                    f"registry accessor(s) {sorted(imported)}: a worker's "
+                    f"singletons are invisible to the controller — inject "
+                    f"sinks explicitly, or justify the record-locally/"
+                    f"ship-deltas pattern with a suppression",
+                ))
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                leaf = name.rsplit(".", 1)[-1] if name else None
+                if leaf in GLOBAL_ACCESSORS:
+                    findings.append(ctx.finding(
+                        "AM502", node,
+                        f"worker-executed module calls process-global "
+                        f"registry accessor {leaf}(): records land in the "
+                        f"worker's own singleton and never surface — "
+                        f"inject sinks explicitly, or justify the "
+                        f"record-locally/ship-deltas pattern with a "
+                        f"suppression",
+                    ))
+    return findings

@@ -111,6 +111,16 @@ class DeviceFaultError(AutomergeError):
     kind = "device"
 
 
+class WorkerCrashError(DeviceFaultError):
+    """A mesh shard's worker process died (crash, kill, or unresponsive
+    heartbeat). Documents whose delivery was in flight when the worker
+    went down are quarantined with this error until released; the shard
+    itself is respawned and re-hydrated from the controller's delivery
+    log (see ``automerge_tpu.parallel.workers``)."""
+
+    kind = "worker_crash"
+
+
 class QuarantinedError(AutomergeError):
     """Delivery shed without processing: the target document is in the
     farm's quarantine set (see ``TpuDocFarm.release_quarantine``)."""

@@ -211,6 +211,54 @@ class MetricsRegistry:
             inst.reset()
 
     # ------------------------------------------------------------------ #
+    # frames: the cross-process shipping format. A mesh worker records
+    # into ITS OWN process-wide registry, periodically takes frame(),
+    # diffs against the last-shipped frame, and sends the delta with the
+    # result; the controller merge_frame()s it into the controller
+    # registry. Counters/histograms accumulate (deltas), gauges are
+    # last-writer-wins — the same semantics a scrape-and-sum pipeline
+    # would apply.
+
+    def frame(self) -> dict:
+        """{name: (kind, help, payload)} snapshot of raw instrument state
+        (picklable, no instrument objects). Counter/gauge payload is the
+        value; histogram payload is (buckets, count, sum)."""
+        out = {}
+        for name, inst in self._instruments.items():
+            if isinstance(inst, Histogram):
+                out[name] = (
+                    "histogram", inst.help,
+                    (dict(inst.buckets), inst.count, inst.sum),
+                )
+            elif isinstance(inst, Gauge):
+                out[name] = ("gauge", inst.help, inst.value)
+            else:
+                out[name] = ("counter", inst.help, inst.value)
+        return out
+
+    def merge_frame(self, frame: dict) -> None:
+        """Accumulates a (delta) frame into this registry: counters are
+        inc'd, histogram buckets/count/sum are added, gauges are set.
+        Instruments are registered on first sight with the frame's help
+        text. No-op while the registry is disabled (instruments drop the
+        records anyway; skipping keeps disabled-path cost flat)."""
+        if not self.enabled:
+            return
+        for name, (kind, help, payload) in sorted(frame.items()):
+            if kind == "histogram":
+                h = self.histogram(name, help)
+                buckets, count, sum_ = payload
+                for b, c in buckets.items():
+                    h.buckets[b] = h.buckets.get(b, 0) + c
+                h.count += count
+                h.sum += sum_
+            elif kind == "gauge":
+                self.gauge(name, help).set(payload)
+            else:
+                self.counter(name, help).inc(payload)
+
+
+    # ------------------------------------------------------------------ #
 
     def as_dict(self) -> dict:
         return {
@@ -251,6 +299,35 @@ def _fmt(v) -> str:
     if isinstance(v, float):
         return f"{v:.4g}"
     return str(v)
+
+
+def diff_frames(current: dict, previous: dict) -> dict:
+    """The delta frame that, ``merge_frame``'d after `previous`, yields
+    `current`: counter values subtract, histogram buckets/count/sum
+    subtract (unchanged buckets drop), gauges pass through as-is.
+    Entries with nothing new are omitted — a quiet worker ships an empty
+    dict."""
+    out = {}
+    for name, (kind, help, payload) in current.items():
+        prev = previous.get(name)
+        if kind == "counter":
+            base = prev[2] if prev else 0
+            if payload != base:
+                out[name] = (kind, help, payload - base)
+        elif kind == "gauge":
+            if prev is None or payload != prev[2]:
+                out[name] = (kind, help, payload)
+        else:
+            buckets, count, sum_ = payload
+            pb, pc, ps = prev[2] if prev else ({}, 0, 0.0)
+            if count != pc:
+                delta = {
+                    b: c - pb.get(b, 0)
+                    for b, c in buckets.items()
+                    if c != pb.get(b, 0)
+                }
+                out[name] = (kind, help, (delta, count - pc, sum_ - ps))
+    return out
 
 
 # ---------------------------------------------------------------------- #

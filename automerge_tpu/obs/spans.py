@@ -234,12 +234,24 @@ class Trace:
         """Rebuilds a trace from `to_jsonl` output (order-insensitive;
         repeated paths accumulate, so concatenated dumps merge)."""
         trace = cls()
+        trace.absorb_jsonl(text)
+        return trace
+
+    def absorb_jsonl(self, text: str) -> "Trace":
+        """Merges a `to_jsonl` dump into THIS trace in place (same
+        accumulate-on-repeated-path semantics as ``from_jsonl``). This is
+        how a mesh worker's phase totals land in the controller's ambient
+        profile: the worker runs its shard dispatch under its own trace,
+        ships ``to_jsonl()`` back with the result frame, and the
+        controller absorbs it — so ``--watch`` still attributes
+        device_dispatch/transcode time per shard even when the shard
+        lives in another process."""
         for line in text.splitlines():
             line = line.strip()
             if not line:
                 continue
             entry = json.loads(line)
-            node = trace.root
+            node = self.root
             for name in entry["path"]:
                 node = node.child(name)
             node.total_s += entry["total_s"]
@@ -247,7 +259,7 @@ class Trace:
             for b, c in entry.get("buckets", {}).items():
                 b = int(b)
                 node.buckets[b] = node.buckets.get(b, 0) + c
-        return trace
+        return self
 
 
 def _fmt_s(seconds: float | None) -> str:

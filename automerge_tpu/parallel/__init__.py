@@ -1,6 +1,21 @@
 """Multi-chip execution: doc-sharded shard-local farms (meshfarm.py)
-behind one controller, plus ('dp', 'sp') mesh construction (mesh.py)."""
-from .mesh import make_mesh
-from .meshfarm import MeshFarm
+behind one controller, plus ('dp', 'sp') mesh construction (mesh.py) and
+the process-worker runtime (workers.py).
 
+Exports resolve lazily (PEP 562): a spawned mesh worker child imports
+``automerge_tpu.parallel.workers`` through this package, and an eager
+``from .meshfarm import MeshFarm`` here would drag the controller — and
+jax — into every child before the spawn env overrides apply (pinned by
+tests/test_mesh_workers_smoke.py::test_workers_module_imports_without_jax).
+"""
 __all__ = ["MeshFarm", "make_mesh"]
+
+
+def __getattr__(name):
+    if name == "MeshFarm":
+        from .meshfarm import MeshFarm
+        return MeshFarm
+    if name == "make_mesh":
+        from .mesh import make_mesh
+        return make_mesh
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -11,10 +11,7 @@ device (``devices=[...]`` pins shard ``s``'s dispatches under
   ``(num_docs, num_shards)``, so a restarted controller recovers the
   same routing without any persisted table);
 - **fans out** one ``apply_changes`` delivery into per-shard
-  ``apply_changes(isolation="doc")`` sub-dispatches (only shards with
-  active docs dispatch; ``AM_MESH_CONCURRENCY`` > 1 runs them on a
-  thread pool — on real multi-chip hosts the per-shard XLA dispatches
-  overlap, on a single CPU they serialize harmlessly) and **merges** the
+  ``apply_changes(isolation="doc")`` sub-dispatches and **merges** the
   per-shard ``FarmApplyResult``s back into one global-index result;
 - **reconciles** the shard-local actor interner tables every
   ``reconcile_interval`` applies: shards intern actors independently, so
@@ -26,35 +23,71 @@ device (``devices=[...]`` pins shard ``s``'s dispatches under
   page-granular migration (``farm.export_doc`` → id translation →
   ``engine.adopt_rows`` whole-page scatter → source ``evict_doc``),
   driven by per-shard slab page occupancy and the controller's per-doc
-  dispatch histogram.
+  dispatch histogram — explicitly via ``rebalance()``, or as a
+  controller *policy* that runs every ``rebalance_interval`` applies.
+
+Two execution backends share every code path above through a uniform
+per-shard handle interface (``mesh_backend=`` ctor arg / the
+``AM_MESH_BACKEND`` env knob):
+
+- ``"inline"`` (default, the parity oracle): shards are in-process
+  ``TpuDocFarm``s exactly as before; ``AM_MESH_CONCURRENCY`` > 1 runs
+  sub-dispatches on a thread pool — device dispatches overlap, but
+  every shard's HOST work still serializes under one GIL;
+- ``"process"``: each shard's farm lives in its own worker process
+  (``parallel/workers.py``, spawn-context, one JAX client per worker).
+  Deliveries fan out as pickled per-shard column batches, results come
+  back as compact outcome/patch frames (patches stay pickled until
+  someone indexes the result), and the controller additionally keeps
+  three tiny mirrors so untouched shards need zero round trips: a
+  quarantine mirror (the serve batcher reads ``mesh.quarantine`` on
+  every submit), a no-op-patch mirror (clock/heads/maxOp/pending per
+  doc) for docs whose shard was not dispatched, and a per-doc
+  committed-delivery log that re-hydrates a respawned worker after a
+  crash. Worker supervision — heartbeat, crash detection, respawn with
+  re-hydration or quarantine of in-flight docs (``WorkerCrashError``) —
+  is the controller's job; see ``heartbeat`` and ``_recover_worker``.
 
 The facade exposes the exact ``TpuDocFarm`` surface the serving stack
 consumes (``num_docs``, ``quarantine``, ``apply_changes``, ``get_*``,
 ``release_quarantine``), all in GLOBAL doc indexes, so ``SyncFarm`` and
-``DynamicBatcher`` run unmodified over a mesh.
+``DynamicBatcher`` run unmodified over a mesh — with either backend.
 
 Decode-cache ownership: the columnar decode caches are process-global
-and SHARED by every shard on purpose — cached entries hold actor
+and SHARED by every inline shard on purpose — cached entries hold actor
 *strings* and immutable op lists, never interner ids, and each shard
 interns at transcode time into its own tables. Sharing parses is safe;
 sharing interner state would not be, and there is none to share (pinned
-by tests/test_mesh_parity.py).
+by tests/test_mesh_parity.py). Under the process backend each worker
+simply has its own cache with identical behavior (same env knobs travel
+to the worker at spawn).
 """
 from __future__ import annotations
 
 import contextlib
 import contextvars
 import os
+import pickle
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-from ..errors import PackingLimitError
+from ..errors import PackingLimitError, WorkerCrashError, error_kind
 from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
 from ..obs.scope import current_exemplar
-from ..tpu.farm import _APPLIED, FarmApplyResult, TpuDocFarm
+from ..profiling import get_profile
+from ..tpu.farm import (
+    _APPLIED,
+    DocOutcome,
+    FarmApplyResult,
+    TpuDocFarm,
+    _empty_object_patch,
+    exc_from_blob,
+    outcome_from_wire,
+)
+from .workers import WorkerHandle
 
 _METRICS = get_metrics()
 _M_SHARDS = _METRICS.gauge("mesh.shards", "shards in the mesh farm")
@@ -75,6 +108,27 @@ _M_RECONCILE_SYNCED = _METRICS.counter(
 _M_REBALANCE = _METRICS.counter(
     "mesh.rebalance.moves",
     "documents migrated by the occupancy-driven rebalancer",
+)
+_M_W_SPAWNS = _METRICS.counter(
+    "mesh.worker.spawns", "mesh worker processes started (incl. respawns)"
+)
+_M_W_CRASHES = _METRICS.counter(
+    "mesh.worker.crashes",
+    "mesh worker deaths detected (pipe EOF, exit, timeout)",
+)
+_M_W_RESPAWNS = _METRICS.counter(
+    "mesh.worker.respawns", "crashed mesh workers brought back up"
+)
+_M_W_RPCS = _METRICS.counter(
+    "mesh.worker.rpcs", "controller->worker round trips"
+)
+_M_W_REHYDRATED = _METRICS.counter(
+    "mesh.worker.rehydrated_docs",
+    "documents replayed into a respawned worker from the delivery log",
+)
+_M_W_LOST = _METRICS.counter(
+    "mesh.worker.lost_docs",
+    "in-flight documents quarantined because their worker crashed",
 )
 _FLIGHT = get_flight()
 
@@ -119,19 +173,181 @@ def _route(num_docs: int, num_shards: int) -> np.ndarray:
     return (z % np.uint64(num_shards)).astype(np.int64)
 
 
+class _InlineShard:
+    """The in-process twin of ``workers.WorkerHandle``: same per-shard
+    facade over a directly owned ``TpuDocFarm``, so every controller
+    path above the apply fan-out is backend-agnostic."""
+
+    __slots__ = ("farm",)
+
+    def __init__(self, farm: TpuDocFarm):
+        self.farm = farm
+
+    def get_patch(self, loc):
+        return self.farm.get_patch(loc)
+
+    def get_heads(self, loc):
+        return self.farm.get_heads(loc)
+
+    def get_all_changes(self, loc):
+        return self.farm.get_all_changes(loc)
+
+    def get_changes(self, loc, have_deps):
+        return self.farm.get_changes(loc, have_deps)
+
+    def get_change_by_hash(self, loc, hash_):
+        return self.farm.get_change_by_hash(loc, hash_)
+
+    def get_missing_deps(self, loc, heads=()):
+        return self.farm.get_missing_deps(loc, heads)
+
+    def release_quarantine(self, loc=None):
+        return self.farm.release_quarantine(loc)
+
+    def quarantine_map(self):
+        return dict(self.farm.quarantine)
+
+    def force_quarantine(self, loc, exc):
+        self.farm.quarantine[loc] = exc
+
+    def actor_table(self):
+        return list(self.farm.actors.table)
+
+    def intern_actors(self, actors):
+        missing = [a for a in actors if self.farm.actors.find(a) is None]
+        for a in missing:
+            self.farm.actors.intern(a)
+        return len(missing)
+
+    def export_doc(self, loc):
+        return self.farm.export_doc(loc)
+
+    def adopt_doc(self, loc, export):
+        self.farm.adopt_doc(loc, export)
+
+    def evict_doc(self, loc):
+        self.farm.evict_doc(loc)
+
+    def pages_allocated(self):
+        return int(self.farm.engine.pages.allocated)
+
+    def doc_lengths(self):
+        return self.farm.engine.lengths.tolist()
+
+    def ping(self, timeout=None):
+        return True
+
+    def close(self):
+        pass
+
+
+def _raise_first_shard_error(errors: dict):
+    """Re-raises the FIRST failing shard's exception (lowest shard id)
+    with the shard attached: ``exc.shard`` plus a ``[shard N]`` message
+    prefix. Callers collect errors from EVERY dispatched shard first, so
+    a mid-dispatch failure never abandons other shards' results (pinned
+    by tests/test_mesh_workers.py)."""
+    s = min(errors)
+    exc = errors[s]
+    exc.shard = s
+    if exc.args and isinstance(exc.args[0], str):
+        exc.args = (f"[shard {s}] {exc.args[0]}",) + exc.args[1:]
+    else:
+        exc.args = (f"[shard {s}]",) + tuple(exc.args)
+    raise exc
+
+
+#: placeholder for a patch that still lives inside a shard's pickled frame
+_PENDING = object()
+
+
+class _LazyPatches:
+    """One shard's double-pickled patch column: unpickles on first index."""
+
+    __slots__ = ("_blob", "_patches")
+
+    def __init__(self, blob: bytes):
+        self._blob = blob
+        self._patches = None
+
+    def get(self) -> list:
+        if self._patches is None:
+            self._patches = pickle.loads(self._blob)
+            self._blob = None
+        return self._patches
+
+    def __getstate__(self):  # keep result objects picklable either way
+        return {"blob": self._blob, "patches": self._patches}
+
+    def __setstate__(self, state):
+        self._blob = state["blob"]
+        self._patches = state["patches"]
+
+
+class _MeshApplyResult(FarmApplyResult):
+    """``FarmApplyResult`` whose patches materialize lazily out of the
+    per-shard pickled frames. Indexing (and iteration, which routes
+    through indexing) unpickles the owning shard's frame once and caches
+    the materialized patch in place; callers that only look at
+    ``outcomes`` (the serve batcher's accounting path) never pay the
+    patch unpickle at all. NOTE: the underlying raw list holds
+    ``_PENDING`` placeholders until touched, so serialize via
+    ``list(result)``/iteration, never the raw list object."""
+
+    def __init__(self, patches, outcomes, lazy: dict):
+        super().__init__(patches, outcomes)
+        self._lazy = lazy
+
+    def _materialize(self, i: int):
+        frame, loc = self._lazy.pop(i)
+        patch = frame.get()[loc]
+        list.__setitem__(self, i, patch)
+        return patch
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        v = list.__getitem__(self, i)
+        return self._materialize(i) if v is _PENDING else v
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+
 class MeshFarm:
     """N shard-local TpuDocFarms behind one controller. See module
     docstring.
 
     `num_shards` defaults to the visible device count when `devices` is
     given, else 1. `spare_slots` sizes each shard's migration headroom
-    (empty doc slots a rebalance can adopt into)."""
+    (empty doc slots a rebalance can adopt into). `mesh_backend` picks
+    "inline" (default; env ``AM_MESH_BACKEND``) or "process" workers;
+    `rebalance_interval` arms `rebalance_policy` ("page_load" or a
+    callable taking the mesh) every that many applies. `warm_changes`
+    (process backend) pre-compiles each worker's jit caches against a
+    throwaway farm before the readiness barrier lifts."""
 
     def __init__(self, num_docs: int, num_shards: int | None = None,
                  capacity: int = 1024, quarantine_threshold: int | None = 3,
                  page_size: int | None = None, devices=None,
                  reconcile_interval: int | None = 64,
-                 spare_slots: int | None = None):
+                 spare_slots: int | None = None,
+                 mesh_backend: str | None = None,
+                 rebalance_policy="page_load",
+                 rebalance_interval: int | None = None,
+                 worker_timeout: float | None = None,
+                 warm_changes=None):
+        if mesh_backend is None:
+            mesh_backend = os.environ.get("AM_MESH_BACKEND", "inline")
+        if mesh_backend not in ("inline", "process"):
+            # amlint: disable=AM401 — API-usage validation, not a
+            # data-plane fault (nothing was decoded or dispatched)
+            raise ValueError(
+                f"mesh_backend must be 'inline' or 'process', "
+                f"got {mesh_backend!r}"
+            )
         if num_shards is None:
             num_shards = len(devices) if devices else 1
         if num_shards < 1 or num_docs < num_shards:
@@ -143,7 +359,10 @@ class MeshFarm:
             )
         self.num_docs = num_docs
         self.num_shards = num_shards
+        self.backend = mesh_backend
         self.reconcile_interval = reconcile_interval
+        self.rebalance_policy = rebalance_policy
+        self.rebalance_interval = rebalance_interval
         self._devices = list(devices) if devices else None
         self._shard_of = _route(num_docs, num_shards)
         self._local_of = np.zeros(num_docs, np.int64)
@@ -151,7 +370,10 @@ class MeshFarm:
             spare_slots = max(2, (num_docs // num_shards) // 8)
         self._owners: list[list] = []
         self._free: list[list] = []
+        self._slots: list[int] = []
         self.shards: list[TpuDocFarm] = []
+        self._handles: list = []
+        specs = []
         for s in range(num_shards):
             mine = np.nonzero(self._shard_of == s)[0]
             self._local_of[mine] = np.arange(len(mine), dtype=np.int64)
@@ -159,18 +381,50 @@ class MeshFarm:
             self._free.append(
                 list(range(len(mine) + spare_slots - 1, len(mine) - 1, -1))
             )
-            with self._device_ctx(s):
-                self.shards.append(TpuDocFarm(
-                    len(mine) + spare_slots, capacity=capacity,
-                    quarantine_threshold=quarantine_threshold,
-                    page_size=page_size,
-                ))
+            self._slots.append(len(mine) + spare_slots)
+            specs.append(dict(
+                shard=s, num_docs=len(mine) + spare_slots,
+                capacity=capacity, quarantine_threshold=quarantine_threshold,
+                page_size=page_size, env=(),
+                warm_buffers=tuple(warm_changes) if warm_changes else None,
+            ))
+        if mesh_backend == "process":
+            # start every worker before awaiting any readiness message,
+            # so farm construction + jit warmup overlap across workers
+            self._handles = [
+                WorkerHandle(
+                    spec, timeout=worker_timeout, defer_ready=True,
+                    on_delta=_METRICS.merge_frame, on_rpc=_M_W_RPCS.inc,
+                )
+                for spec in specs
+            ]
+            ready = [h.ensure_ready() for h in self._handles]
+            _M_W_SPAWNS.inc(num_shards)
+            if _FLIGHT.enabled:
+                for s, pid in enumerate(ready):
+                    _FLIGHT.record("mesh.worker.spawn", shard=s, pid=pid)
+        else:
+            for s, slots in enumerate(self._slots):
+                with self._device_ctx(s):
+                    self.shards.append(TpuDocFarm(
+                        slots, capacity=capacity,
+                        quarantine_threshold=quarantine_threshold,
+                        page_size=page_size,
+                    ))
+            self._handles = [_InlineShard(f) for f in self.shards]
+        # process-backend controller mirrors (see module docstring):
+        # quarantine cache, per-doc no-op-patch state, committed-delivery
+        # log for crash re-hydration
+        self._qcache: dict[int, BaseException] = {}
+        self._noop_state: list = [(0, {}, [], 0) for _ in range(num_docs)]
+        self._doc_log: dict[int, list] = {}
         self._calls = 0
         self._doc_dispatches = np.zeros(num_docs, np.int64)
         workers = int(os.environ.get("AM_MESH_CONCURRENCY", "1"))
         self._executor = (
             ThreadPoolExecutor(max_workers=min(workers, num_shards))
-            if workers > 1 and num_shards > 1 else None
+            if workers > 1 and num_shards > 1 and mesh_backend == "inline"
+            else None
         )
         _M_SHARDS.set(num_shards)
 
@@ -178,7 +432,7 @@ class MeshFarm:
     # routing
 
     def _device_ctx(self, s: int):
-        if self._devices is None:
+        if self._devices is None or self.backend == "process":
             return contextlib.nullcontext()
         import jax
 
@@ -190,9 +444,59 @@ class MeshFarm:
         flush accounting."""
         return int(self._shard_of[d])
 
-    def _local(self, d: int) -> tuple[TpuDocFarm, int]:
+    def _local(self, d: int):
         s = self._shard_of[d]
-        return self.shards[s], self._local_of[d]
+        return self._handles[s], self._local_of[d]
+
+    # ------------------------------------------------------------------ #
+    # lifecycle (process backend; inline no-ops)
+
+    def close(self) -> None:
+        """Shuts every worker down cleanly (ack'd shutdown, join,
+        terminate stragglers) and releases the dispatch pool. Idempotent;
+        leaves zero child processes behind."""
+        for h in self._handles:
+            h.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+
+    def heartbeat(self):
+        """Pings every shard; a dead worker is detected here even between
+        deliveries, respawned and re-hydrated (in-flight docs: none —
+        nothing was in flight). Returns {shard: "ok" | "respawned"}."""
+        status = {}
+        for s, h in enumerate(self._handles):
+            try:
+                h.ping()
+                status[s] = "ok"
+            except WorkerCrashError as exc:
+                self._recover_worker(s, in_flight=(), cause=exc,
+                                     phase="heartbeat")
+                status[s] = "respawned"
+        return status
+
+    def inject_worker_fault(self, shard: int, when: str = "next_apply"):
+        """Test/chaos hook (process backend only): make `shard`'s worker
+        SIGKILL itself — immediately (`when="now"`, fire-and-forget) or
+        at its next apply (`"next_apply"`, i.e. mid-delivery from the
+        controller's point of view)."""
+        if self.backend != "process":
+            # amlint: disable=AM401 — API-usage validation, not a
+            # data-plane fault (nothing was decoded or dispatched)
+            raise ValueError("worker fault injection needs the process "
+                             "backend")
+        h = self._handles[shard]
+        if when == "now":
+            h.request("_debug_die_now")
+        else:
+            h.call("_debug_die_on_next_apply")
 
     # ------------------------------------------------------------------ #
     # the fan-out data plane
@@ -216,16 +520,40 @@ class MeshFarm:
         _M_APPLY.inc()
         shard_of, local_of = self._shard_of, self._local_of
         active = [d for d, bufs in enumerate(per_doc_buffers) if bufs]
-        subs = [
-            [[] for _ in range(f.num_docs)] for f in self.shards
-        ]
-        for d in active:
-            subs[shard_of[d]][local_of[d]] = list(per_doc_buffers[d])
         np.add.at(self._doc_dispatches, active, 1)
         touched = sorted({shard_of[d] for d in active})
         counts = {
             s: sum(1 for d in active if shard_of[d] == s) for s in touched
         }
+        if self.backend == "process":
+            result = self._apply_process(
+                per_doc_buffers, active, touched, counts, is_local
+            )
+        else:
+            result = self._apply_inline(
+                per_doc_buffers, active, touched, counts, is_local
+            )
+        if self.reconcile_interval and (
+            self._calls % self.reconcile_interval == 0
+        ):
+            self.reconcile_actors()
+        if self.rebalance_interval and (
+            self._calls % self.rebalance_interval == 0
+        ):
+            if callable(self.rebalance_policy):
+                self.rebalance_policy(self)
+            elif self.rebalance_policy == "page_load":
+                self.rebalance()
+        return result
+
+    def _apply_inline(self, per_doc_buffers, active, touched, counts,
+                      is_local):
+        shard_of, local_of = self._shard_of, self._local_of
+        subs = [
+            [[] for _ in range(f.num_docs)] for f in self.shards
+        ]
+        for d in active:
+            subs[shard_of[d]][local_of[d]] = list(per_doc_buffers[d])
 
         def run_shard(s):
             t0 = time.perf_counter()
@@ -254,17 +582,171 @@ class MeshFarm:
             else _APPLIED
             for g in range(self.num_docs)
         ]
-        if self.reconcile_interval and (
-            self._calls % self.reconcile_interval == 0
-        ):
-            self.reconcile_actors()
         return FarmApplyResult(patches, outcomes)
+
+    def _apply_process(self, per_doc_buffers, active, touched, counts,
+                       is_local):
+        """Send-all-then-collect fan-out: every touched worker receives
+        its pickled column batch before any result is awaited, so the
+        per-shard host phases genuinely overlap across processes. The
+        collect loop ALWAYS drains every touched shard — raising early
+        would leave a queued response in a pipe and desynchronize the
+        whole protocol — then crashes recover, then the first
+        non-crash shard error (lowest shard id) re-raises with its shard
+        attached, exactly like the inline dispatch path."""
+        shard_of, local_of = self._shard_of, self._local_of
+        want_phases = bool(get_profile().enabled)
+        groups = {s: [] for s in touched}
+        for d in active:
+            groups[shard_of[d]].append(
+                (int(local_of[d]), tuple(per_doc_buffers[d]))
+            )
+        sent = []
+        crashed = {}
+        for s in touched:
+            try:
+                self._handles[s].request("apply",
+                                         (groups[s], is_local, want_phases))
+                sent.append(s)
+            except WorkerCrashError as exc:
+                crashed[s] = exc
+        responses = {}
+        errors = {}
+        for s in sent:
+            try:
+                responses[s] = self._handles[s].collect()
+            except WorkerCrashError as exc:
+                crashed[s] = exc
+            except BaseException as exc:
+                errors[s] = exc
+        prof = get_profile()
+        for s, resp in sorted(responses.items()):
+            if _METRICS.enabled:
+                _shard_dispatch_ms(s).observe(
+                    resp["wall_s"] * 1000.0, exemplar=current_exemplar()
+                )
+                _shard_docs(s).inc(counts[s])
+            if resp["phases"] and prof.enabled:
+                prof.absorb_jsonl(resp["phases"])
+            owners = self._owners[s]
+            for loc, state in resp["noop"].items():
+                self._noop_state[owners[loc]] = state
+            for loc, blob in resp["q_entered"].items():
+                self._qcache[owners[loc]] = exc_from_blob(blob)
+        crash_outcomes = {}
+        for s, cause in sorted(crashed.items()):
+            in_flight = [d for d in active if shard_of[d] == s]
+            crash_outcomes.update(
+                self._recover_worker(s, in_flight, cause, phase="apply")
+            )
+        if errors:
+            _raise_first_shard_error(errors)
+        frames = {
+            s: _LazyPatches(resp["patches"])
+            for s, resp in responses.items()
+        }
+        outcome_cols = {
+            s: [outcome_from_wire(w) for w in resp["outcomes"]]
+            for s, resp in responses.items()
+        }
+        outcomes = [
+            outcome_cols[shard_of[g]][local_of[g]]
+            if shard_of[g] in outcome_cols
+            else crash_outcomes.get(g, _APPLIED)
+            for g in range(self.num_docs)
+        ]
+        lazy = {
+            g: (frames[s], loc)
+            for s in frames
+            for loc, g in enumerate(self._owners[s])
+            if g is not None
+        }
+        patches = [
+            _PENDING if g in lazy else self._noop_patch_mirror(g)
+            for g in range(self.num_docs)
+        ]
+        committed = [
+            d for d in active
+            if outcomes[d].status == "applied"
+        ]
+        for d in committed:
+            self._doc_log.setdefault(d, []).append(
+                (tuple(per_doc_buffers[d]), is_local)
+            )
+        return _MeshApplyResult(patches, outcomes, lazy)
+
+    def _noop_patch_mirror(self, g: int) -> dict:
+        """The patch of a delivery that changed nothing, built from the
+        controller's no-op mirror — byte-identical to the owning farm's
+        ``_noop_patch`` without a round trip."""
+        max_op, clock, heads, pending = self._noop_state[g]
+        return {
+            "maxOp": max_op,
+            "clock": dict(clock),
+            "deps": list(heads),
+            "pendingChanges": pending,
+            "diffs": _empty_object_patch("_root", "map"),
+        }
+
+    def _recover_worker(self, s: int, in_flight, cause, phase: str):
+        """Crash recovery: respawn shard `s`'s worker, re-hydrate its
+        committed state by replaying the controller's per-doc delivery
+        log, re-impose surviving quarantines, and quarantine the docs
+        whose delivery was in flight when the worker died (taxonomy:
+        ``WorkerCrashError``, kind "worker_crash"). Returns
+        {global doc: DocOutcome} for the in-flight docs."""
+        h = self._handles[s]
+        old_pid = h.pid
+        _M_W_CRASHES.inc()
+        if _FLIGHT.enabled:
+            _FLIGHT.record("mesh.worker.crash", shard=s, pid=old_pid,
+                           phase=phase, cause=str(cause))
+        new_pid = h.respawn()
+        _M_W_SPAWNS.inc()
+        _M_W_RESPAWNS.inc()
+        owned = [g for g in self._owners[s] if g is not None]
+        in_flight = set(in_flight)
+        replay_items = [
+            (int(self._local_of[g]), self._doc_log.get(g, []))
+            for g in owned
+        ]
+        rehydrated = h.replay(replay_items)
+        _M_W_REHYDRATED.inc(rehydrated)
+        survivors_quarantined = [
+            g for g in owned if g in self._qcache and g not in in_flight
+        ]
+        for g in survivors_quarantined:
+            h.force_quarantine(int(self._local_of[g]), self._qcache[g])
+        outcomes = {}
+        for g in sorted(in_flight):
+            err = WorkerCrashError(
+                f"worker for shard {s} (pid {old_pid}) died mid-delivery; "
+                f"doc {g}'s delivery was in flight and is quarantined "
+                f"pending release ({cause})"
+            )
+            self._qcache[g] = err
+            h.force_quarantine(int(self._local_of[g]), err)
+            _M_W_LOST.inc()
+            outcomes[g] = DocOutcome("quarantined", err, error_kind(err))
+        if _FLIGHT.enabled:
+            _FLIGHT.record(
+                "mesh.worker.respawn", shard=s, pid=new_pid,
+                rehydrated=rehydrated, lost=len(in_flight),
+            )
+        return outcomes
 
     def _dispatch_shards(self, touched, fn):
         """Runs `fn(s)` for every touched shard; concurrently when the
         pool is enabled (context propagated so ambient profile/scope
         state follows each sub-dispatch), serially otherwise. Results
-        come back keyed by shard id either way."""
+        come back keyed by shard id either way. Every future is drained
+        before any failure surfaces — a mid-dispatch shard exception
+        neither deadlocks the pool nor abandons other shards' completed
+        results — and the FIRST failing shard's exception (lowest shard
+        id) re-raises with the shard id attached (``exc.shard`` + a
+        message prefix)."""
+        results = {}
+        errors = {}
         if self._executor is not None and len(touched) > 1:
             futures = {
                 s: self._executor.submit(
@@ -272,8 +754,20 @@ class MeshFarm:
                 )
                 for s in touched
             }
-            return {s: futures[s].result() for s in touched}
-        return {s: fn(s) for s in touched}
+            for s in touched:
+                try:
+                    results[s] = futures[s].result()
+                except BaseException as exc:
+                    errors[s] = exc
+        else:
+            for s in touched:
+                try:
+                    results[s] = fn(s)
+                except BaseException as exc:
+                    errors[s] = exc
+        if errors:
+            _raise_first_shard_error(errors)
+        return results
 
     # ------------------------------------------------------------------ #
     # cross-shard actor reconcile
@@ -285,17 +779,14 @@ class MeshFarm:
         number of entries copied; a converged mesh returns 0."""
         union: list[str] = []
         seen: set[str] = set()
-        for f in self.shards:
-            for a in f.actors.table:
+        for h in self._handles:
+            for a in h.actor_table():
                 if a not in seen:
                     seen.add(a)
                     union.append(a)
         synced = 0
-        for f in self.shards:
-            missing = [a for a in union if f.actors.find(a) is None]
-            for a in missing:
-                f.actors.intern(a)
-            synced += len(missing)
+        for h in self._handles:
+            synced += h.intern_actors(union)
         _M_RECONCILE_RUNS.inc()
         _M_RECONCILE_SYNCED.inc(synced)
         if _FLIGHT.enabled:
@@ -311,7 +802,9 @@ class MeshFarm:
         """Moves global doc `d` onto `dest_shard` by whole pages: export
         (dense page readback + host state), id translation into the
         destination farm's interners, one adopt-scatter into freshly
-        allocated pages, then the source slot is evicted and freed."""
+        allocated pages, then the source slot is evicted and freed.
+        Under the process backend the page snapshot travels over the
+        pipe — export and adopt run in two different worker processes."""
         src_shard = int(self._shard_of[d])
         if src_shard == dest_shard:
             return
@@ -319,7 +812,7 @@ class MeshFarm:
             raise PackingLimitError(
                 f"shard {dest_shard} has no free doc slots for migration"
             )
-        src, dst = self.shards[src_shard], self.shards[dest_shard]
+        src, dst = self._handles[src_shard], self._handles[dest_shard]
         l_src = int(self._local_of[d])
         l_dst = self._free[dest_shard].pop()
         export = src.export_doc(l_src)
@@ -343,11 +836,13 @@ class MeshFarm:
         the least-loaded one, up to `max_moves` times, while the page-load
         spread exceeds `min_gain_pages`. Heat = the controller's per-doc
         dispatch counts, tie-broken by row count. Returns the moves as
-        (doc, src_shard, dest_shard) triples."""
+        (doc, src_shard, dest_shard) triples. Runs automatically every
+        `rebalance_interval` applies when armed (the controller policy
+        hook)."""
         moves = []
         for _ in range(max_moves):
             loads = np.fromiter(
-                (f.engine.pages.allocated for f in self.shards),
+                (h.pages_allocated() for h in self._handles),
                 np.int64, count=self.num_shards,
             )
             src_shard = int(np.argmax(loads))
@@ -363,12 +858,12 @@ class MeshFarm:
             ]
             if not candidates:
                 break
-            src = self.shards[src_shard]
+            lengths = self._handles[src_shard].doc_lengths()
             hot = max(
                 candidates,
                 key=lambda g: (
                     self._doc_dispatches[g],
-                    src.engine.lengths[self._local_of[g]],
+                    lengths[self._local_of[g]],
                 ),
             )
             self.migrate_doc(hot, dest_shard)
@@ -385,7 +880,7 @@ class MeshFarm:
         AssertionError on any leak."""
         seen: dict[int, tuple[int, int]] = {}
         for s, owners in enumerate(self._owners):
-            assert len(owners) == self.shards[s].num_docs
+            assert len(owners) == self._slots[s]
             frees = set(self._free[s])
             for loc, g in enumerate(owners):
                 if g is None:
@@ -404,44 +899,54 @@ class MeshFarm:
 
     @property
     def quarantine(self):
-        """{global doc: last failure} across every shard."""
+        """{global doc: last failure} across every shard. Inline reads
+        the live shard sets; the process backend serves the controller's
+        quarantine mirror — the serve batcher hits this on EVERY submit,
+        so it must not fan out round trips."""
+        if self.backend == "process":
+            return dict(self._qcache)
         out = {}
-        for s, f in enumerate(self.shards):
+        for s, h in enumerate(self._handles):
             owners = self._owners[s]
-            for loc, exc in f.quarantine.items():
-                out[owners[loc]] = exc
+            out.update({
+                owners[loc]: exc
+                for loc, exc in h.quarantine_map().items()
+            })
         return out
 
     def release_quarantine(self, doc: int | None = None):
         if doc is not None:
-            f, loc = self._local(doc)
-            return [doc] if f.release_quarantine(loc) else []
-        released = []
-        for s, f in enumerate(self.shards):
-            owners = self._owners[s]
-            released.extend(owners[loc] for loc in f.release_quarantine())
+            h, loc = self._local(doc)
+            released = [doc] if h.release_quarantine(int(loc)) else []
+        else:
+            released = []
+            for s, h in enumerate(self._handles):
+                owners = self._owners[s]
+                released.extend(owners[loc] for loc in h.release_quarantine())
+        for g in released:
+            self._qcache.pop(g, None)
         return released
 
     def get_patch(self, d: int):
-        f, loc = self._local(d)
-        return f.get_patch(loc)
+        h, loc = self._local(d)
+        return h.get_patch(loc)
 
     def get_heads(self, d: int):
-        f, loc = self._local(d)
-        return f.get_heads(loc)
+        h, loc = self._local(d)
+        return h.get_heads(loc)
 
     def get_all_changes(self, d: int):
-        f, loc = self._local(d)
-        return f.get_all_changes(loc)
+        h, loc = self._local(d)
+        return h.get_all_changes(loc)
 
     def get_changes(self, d: int, have_deps):
-        f, loc = self._local(d)
-        return f.get_changes(loc, have_deps)
+        h, loc = self._local(d)
+        return h.get_changes(loc, have_deps)
 
     def get_change_by_hash(self, d: int, hash_):
-        f, loc = self._local(d)
-        return f.get_change_by_hash(loc, hash_)
+        h, loc = self._local(d)
+        return h.get_change_by_hash(loc, hash_)
 
     def get_missing_deps(self, d: int, heads=()):
-        f, loc = self._local(d)
-        return f.get_missing_deps(loc, heads)
+        h, loc = self._local(d)
+        return h.get_missing_deps(loc, heads)

@@ -1,0 +1,498 @@
+"""Mesh worker runtime: one shard's ``TpuDocFarm`` in its own process.
+
+``MeshFarm(mesh_backend="process")`` pairs every shard with a worker
+process (this module), mirroring how TPU inference stacks pair each
+device with a host-side worker around a shared paged layout: the
+controller keeps only the routing arrays, the actor reconcile and the
+result fan-in, while ALL of a shard's host work — decode, column
+transcode, device dispatch, patch materialization — runs under the
+worker's own Python interpreter and its own JAX client. That is what
+turns the mesh's device-dispatch scaling into wall-clock scaling: the
+per-shard host phases that serialized under one GIL in the inline
+backend now run in N processes.
+
+Protocol (length-framed pickles over a ``multiprocessing`` pipe):
+
+- parent -> child: ``(op, payload)`` — deliveries fan out as pickled
+  per-shard column batches (raw change bytes + local routing indices;
+  shards share NO mutable state, so nothing else needs to travel);
+- child -> parent: ``(status, payload, metrics_delta)`` — apply results
+  return as compact frames (double-pickled patch blob + flat outcome
+  tuples, see ``tpu.farm.result_to_wire``) so the controller defers
+  patch materialization until someone actually indexes the result;
+  every response piggybacks the worker registry's metric delta and, on
+  request, the worker's phase-profile dump for ``--watch`` attribution.
+
+Workers are spawned with the **spawn** (not fork) start method: a forked
+JAX client shares page-table state with the parent and corrupts both;
+spawn gives each worker a pristine interpreter. Consequently this module
+must import cleanly WITHOUT pulling in jax or the farm — the heavy
+imports happen inside ``_worker_main`` after the spawn env overrides are
+applied (pinned by tests/test_mesh_workers_smoke.py).
+
+Supervision lives in ``WorkerHandle``: readiness barrier at spawn,
+heartbeat ping, crash detection on every receive (pipe EOF, dead
+process, timeout), SIGKILL-hard ``close``. Respawn + doc re-hydration
+policy is the controller's (meshfarm.py) — the handle only detects and
+reports via ``WorkerCrashError``.
+"""
+# amlint: mesh-worker
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+from ..errors import WorkerCrashError
+
+_PING_TIMEOUT_S = 5.0
+
+
+# ---------------------------------------------------------------------- #
+# worker child
+
+
+def _strip_forced_devices(env: dict) -> dict:
+    """Drops ``--xla_force_host_platform_device_count`` from XLA_FLAGS:
+    the controller may force N virtual host devices for the inline
+    backend, but each worker owns exactly one real client."""
+    flags = env.get("XLA_FLAGS")
+    if flags and "--xla_force_host_platform_device_count" in flags:
+        kept = [
+            f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        env = dict(env)
+        env["XLA_FLAGS"] = " ".join(kept)
+        if not env["XLA_FLAGS"]:
+            del env["XLA_FLAGS"]
+    return env
+
+
+def _worker_main(conn, spec: dict) -> None:
+    """Child entry point. Applies the spawn env overrides BEFORE the
+    heavy imports (jax reads its env at client init), builds the shard
+    farm, optionally pre-warms the jit caches against a throwaway farm,
+    then serves the op loop until shutdown/EOF."""
+    for k, v in spec["env"]:
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    stripped = _strip_forced_devices(dict(os.environ))
+    if "XLA_FLAGS" in os.environ and "XLA_FLAGS" not in stripped:
+        del os.environ["XLA_FLAGS"]
+    os.environ.update(stripped)
+
+    # each worker records into ITS OWN process-wide registry and ships
+    # deltas back with every response; the controller merges them.
+    # amlint: disable=AM502 — this IS the worker's own registry: the
+    # process-global singleton of the *worker* process, never the
+    # controller's (deltas ship via diff_frames/merge_frame)
+    from ..obs.metrics import diff_frames, get_metrics
+    from ..profiling import PhaseProfile, use_profile
+    from ..tpu.farm import TpuDocFarm, exc_from_blob, exc_to_blob, result_to_wire
+
+    metrics = get_metrics()  # amlint: disable=AM502 — same shipping buffer
+    metrics.enable()
+    farm_args = dict(
+        capacity=spec["capacity"],
+        quarantine_threshold=spec["quarantine_threshold"],
+        page_size=spec["page_size"],
+    )
+    farm = TpuDocFarm(spec["num_docs"], **farm_args)
+    if spec.get("warm_buffers"):
+        # compile the all-docs-active dispatch shapes into THIS process's
+        # jit cache before the readiness barrier lifts, so the measured
+        # window never includes worker-side compilation
+        warm = TpuDocFarm(spec["num_docs"], **farm_args)
+        warm.apply_changes(
+            [list(spec["warm_buffers"]) for _ in range(warm.num_docs)],
+            isolation="doc",
+        )
+        del warm
+    last_frame = metrics.frame()
+    conn.send(("ready", os.getpid(), None))
+
+    crash_armed = False
+    while True:
+        try:
+            op, payload = conn.recv()
+        except (EOFError, OSError):
+            break
+        if op == "shutdown":
+            conn.send(("ok", None, None))
+            break
+        if op == "_debug_die_now":
+            # fire-and-forget test hook: die as if kill -9'd externally
+            os.kill(os.getpid(), signal.SIGKILL)
+        if op == "_debug_die_on_next_apply":
+            crash_armed = True
+            conn.send(("ok", None, None))
+            continue
+        try:
+            if op == "apply":
+                if crash_armed:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                resp = _do_apply(
+                    farm, payload, PhaseProfile, use_profile, result_to_wire,
+                    exc_to_blob,
+                )
+            else:
+                resp = _dispatch(farm, op, payload, exc_to_blob, exc_from_blob)
+            frame = metrics.frame()
+            delta = diff_frames(frame, last_frame)
+            last_frame = frame
+            try:
+                conn.send(("ok", resp, delta))
+            except Exception as send_exc:  # unpicklable response payload
+                conn.send(("err", exc_to_blob(send_exc), delta))
+        except BaseException as exc:  # ship the failure; keep serving
+            frame = metrics.frame()
+            delta = diff_frames(frame, last_frame)
+            last_frame = frame
+            conn.send(("err", exc_to_blob(exc), delta))
+
+
+def _do_apply(farm, payload, PhaseProfile, use_profile, result_to_wire,
+              exc_to_blob) -> dict:
+    active, is_local, want_phases = payload
+    per_doc = [[] for _ in range(farm.num_docs)]
+    for loc, bufs in active:
+        per_doc[loc] = list(bufs)
+    q_before = set(farm.quarantine)
+    t0 = time.perf_counter()
+    if want_phases:
+        prof = PhaseProfile()
+        with use_profile(prof):
+            result = farm.apply_changes(per_doc, is_local=is_local,
+                                        isolation="doc")
+        phases = prof.to_jsonl()
+    else:
+        result = farm.apply_changes(per_doc, is_local=is_local,
+                                    isolation="doc")
+        phases = ""
+    wall_s = time.perf_counter() - t0
+    resp = result_to_wire(result)
+    # the controller's quarantine mirror and no-op-patch mirror update
+    # from these two deltas — untouched shards then serve facade reads
+    # with ZERO round trips
+    resp["q_entered"] = {
+        loc: exc_to_blob(farm.quarantine[loc])
+        for loc in set(farm.quarantine) - q_before
+    }
+    resp["noop"] = {
+        loc: (farm.max_op[loc], dict(farm.clock[loc]),
+              list(farm.heads[loc]), len(farm.queue[loc]))
+        for loc, _ in active
+    }
+    resp["phases"] = phases
+    resp["wall_s"] = wall_s
+    return resp
+
+
+def _dispatch(farm, op: str, payload, exc_to_blob, exc_from_blob):
+    if op == "get_patch":
+        return farm.get_patch(payload)
+    if op == "get_heads":
+        return farm.get_heads(payload)
+    if op == "get_all_changes":
+        return farm.get_all_changes(payload)
+    if op == "get_changes":
+        loc, have_deps = payload
+        return farm.get_changes(loc, have_deps)
+    if op == "get_change_by_hash":
+        loc, hash_ = payload
+        return farm.get_change_by_hash(loc, hash_)
+    if op == "get_missing_deps":
+        loc, heads = payload
+        return farm.get_missing_deps(loc, heads)
+    if op == "noop_state":
+        loc = payload
+        return (farm.max_op[loc], dict(farm.clock[loc]),
+                list(farm.heads[loc]), len(farm.queue[loc]))
+    if op == "release_quarantine":
+        return farm.release_quarantine(payload)
+    if op == "quarantine_map":
+        return {loc: exc_to_blob(e) for loc, e in farm.quarantine.items()}
+    if op == "force_quarantine":
+        loc, blob = payload
+        farm.quarantine[loc] = exc_from_blob(blob)
+        return None
+    if op == "actor_table":
+        return list(farm.actors.table)
+    if op == "intern_actors":
+        missing = [a for a in payload if farm.actors.find(a) is None]
+        for a in missing:
+            farm.actors.intern(a)
+        return len(missing)
+    if op == "export_doc":
+        return farm.export_doc(payload)
+    if op == "adopt_doc":
+        loc, export = payload
+        farm.adopt_doc(loc, export)
+        return None
+    if op == "evict_doc":
+        farm.evict_doc(payload)
+        return None
+    if op == "pages_allocated":
+        return int(farm.engine.pages.allocated)
+    if op == "doc_lengths":
+        return farm.engine.lengths.tolist()
+    if op == "replay":
+        # crash re-hydration: the controller's committed delivery log,
+        # replayed per doc in order. Doc-isolated applies commute across
+        # docs, so per-doc replay reproduces the pre-crash patch state
+        # byte for byte (pinned by tests/test_mesh_workers.py).
+        rehydrated = 0
+        for loc, deliveries in payload:
+            for bufs, is_local in deliveries:
+                per_doc = [[] for _ in range(farm.num_docs)]
+                per_doc[loc] = list(bufs)
+                farm.apply_changes(per_doc, is_local=is_local,
+                                   isolation="doc")
+            if deliveries:
+                rehydrated += 1
+        return rehydrated
+    if op == "ping":
+        return "pong"
+    raise ValueError(f"unknown mesh worker op {op!r}")
+
+
+# ---------------------------------------------------------------------- #
+# controller-side handle
+
+
+class WorkerHandle:
+    """One shard worker's lifecycle + RPC surface, controller side.
+
+    ``request``/``collect`` are split so the controller can fan a
+    delivery out to every touched shard before collecting any result
+    (the workers overlap); ``call`` is the sequential convenience. Every
+    receive path detects death — pipe EOF, exited process, timeout — and
+    raises ``WorkerCrashError``; recovery policy (respawn, re-hydrate,
+    quarantine in-flight docs) belongs to the controller.
+
+    ``on_delta`` receives each response's metric delta frame;
+    ``on_rpc`` fires once per request (both injected by meshfarm so this
+    module never touches the controller's process-global registries)."""
+
+    def __init__(self, spec: dict, timeout: float | None = None,
+                 on_delta=None, on_rpc=None, defer_ready: bool = False):
+        self.spec = spec
+        if timeout is None:
+            timeout = float(os.environ.get("AM_MESH_WORKER_TIMEOUT_S", "600"))
+        self.timeout = timeout
+        self._on_delta = on_delta
+        self._on_rpc = on_rpc
+        self.conn = None
+        self.proc = None
+        self._ready = False
+        self._start()
+        if not defer_ready:
+            self.ensure_ready()
+
+    # -- lifecycle ----------------------------------------------------- #
+
+    def _start(self) -> None:
+        ctx = mp.get_context("spawn")
+        parent_conn, child_conn = ctx.Pipe()
+        proc = ctx.Process(
+            target=_worker_main, args=(child_conn, self.spec),
+            daemon=True, name=f"am-mesh-worker-{self.spec['shard']}",
+        )
+        proc.start()
+        child_conn.close()
+        self.conn, self.proc = parent_conn, proc
+        self._ready = False
+
+    def ensure_ready(self) -> int:
+        """Blocks on the worker's readiness message (farm built, jit
+        caches warmed). Deferring this lets a controller start every
+        worker first so their initialization overlaps. Returns the
+        worker pid."""
+        if self._ready:
+            return self.pid
+        msg = self._recv(self.timeout)
+        if msg[0] != "ready":
+            self._kill()
+            raise WorkerCrashError(
+                f"shard {self.spec['shard']} worker sent {msg[0]!r} "
+                "instead of readiness"
+            )
+        self._ready = True
+        return msg[1]
+
+    def spawn(self) -> int:
+        """Starts the worker and waits for readiness. Returns the pid."""
+        self._start()
+        return self.ensure_ready()
+
+    def respawn(self) -> int:
+        self._kill()
+        return self.spawn()
+
+    def _kill(self) -> None:
+        if self.proc is None:
+            return
+        if self.proc.is_alive():
+            self.proc.terminate()
+            self.proc.join(1.0)
+        if self.proc.is_alive():
+            self.proc.kill()
+            self.proc.join(1.0)
+        if self.conn is not None:
+            self.conn.close()
+        self.conn = self.proc = None
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Clean shutdown: ack'd shutdown op, then join; stragglers are
+        terminated. Leaves zero child processes behind (pinned by
+        tests/test_mesh_workers_smoke.py)."""
+        if self.proc is None:
+            return
+        try:
+            self.conn.send(("shutdown", None))
+            deadline = time.monotonic() + timeout
+            while self.proc.is_alive() and time.monotonic() < deadline:
+                if self.conn.poll(0.05):
+                    self.conn.recv()  # the shutdown ack (or a straggler)
+                else:
+                    self.proc.join(0.05)
+        except (OSError, EOFError, BrokenPipeError):
+            pass
+        self._kill()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.is_alive()
+
+    @property
+    def pid(self) -> int | None:
+        return None if self.proc is None else self.proc.pid
+
+    # -- transport ----------------------------------------------------- #
+
+    def _crash(self, why: str) -> WorkerCrashError:
+        return WorkerCrashError(
+            f"shard {self.spec['shard']} worker (pid {self.pid}): {why}"
+        )
+
+    def _recv(self, timeout: float):
+        if self.conn is None:
+            raise self._crash("not running")
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._kill()
+                raise self._crash(f"no response within {timeout:.0f}s")
+            try:
+                if self.conn.poll(min(0.2, remaining)):
+                    return self.conn.recv()
+            except (EOFError, OSError) as e:
+                raise self._crash(f"pipe closed mid-receive ({e!r})") from e
+            if not self.proc.is_alive():
+                # drain a final message the worker flushed before dying
+                try:
+                    if self.conn.poll(0):
+                        return self.conn.recv()
+                except (EOFError, OSError):
+                    pass
+                raise self._crash(
+                    f"process died (exitcode {self.proc.exitcode})"
+                )
+
+    def request(self, op: str, payload=None) -> None:
+        if self._on_rpc is not None:
+            self._on_rpc()
+        if self.conn is None:
+            raise self._crash("not running")
+        try:
+            self.conn.send((op, payload))
+        except (OSError, BrokenPipeError, ValueError) as e:
+            raise self._crash(f"pipe closed mid-send ({e!r})") from e
+
+    def collect(self, timeout: float | None = None):
+        status, payload, delta = self._recv(
+            self.timeout if timeout is None else timeout
+        )
+        if delta and self._on_delta is not None:
+            self._on_delta(delta)
+        if status == "err":
+            from ..tpu.farm import exc_from_blob
+
+            raise exc_from_blob(payload)
+        return payload
+
+    def call(self, op: str, payload=None, timeout: float | None = None):
+        self.request(op, payload)
+        return self.collect(timeout)
+
+    # -- the shard facade (local doc indexes) -------------------------- #
+
+    def get_patch(self, loc):
+        return self.call("get_patch", loc)
+
+    def get_heads(self, loc):
+        return self.call("get_heads", loc)
+
+    def get_all_changes(self, loc):
+        return self.call("get_all_changes", loc)
+
+    def get_changes(self, loc, have_deps):
+        return self.call("get_changes", (loc, have_deps))
+
+    def get_change_by_hash(self, loc, hash_):
+        return self.call("get_change_by_hash", (loc, hash_))
+
+    def get_missing_deps(self, loc, heads=()):
+        return self.call("get_missing_deps", (loc, heads))
+
+    def release_quarantine(self, loc=None):
+        return self.call("release_quarantine", loc)
+
+    def quarantine_map(self) -> dict:
+        from ..tpu.farm import exc_from_blob
+
+        return {
+            loc: exc_from_blob(blob)
+            for loc, blob in self.call("quarantine_map").items()
+        }
+
+    def force_quarantine(self, loc, exc) -> None:
+        from ..tpu.farm import exc_to_blob
+
+        self.call("force_quarantine", (loc, exc_to_blob(exc)))
+
+    def actor_table(self):
+        return self.call("actor_table")
+
+    def intern_actors(self, actors):
+        return self.call("intern_actors", list(actors))
+
+    def export_doc(self, loc):
+        return self.call("export_doc", loc)
+
+    def adopt_doc(self, loc, export) -> None:
+        self.call("adopt_doc", (loc, export))
+
+    def evict_doc(self, loc) -> None:
+        self.call("evict_doc", loc)
+
+    def pages_allocated(self):
+        return self.call("pages_allocated")
+
+    def doc_lengths(self):
+        return self.call("doc_lengths")
+
+    def noop_state(self, loc):
+        return self.call("noop_state", loc)
+
+    def replay(self, items):
+        return self.call("replay", items)
+
+    def ping(self, timeout: float = _PING_TIMEOUT_S) -> bool:
+        self.request("ping")
+        return self.collect(timeout) == "pong"

@@ -31,6 +31,19 @@ Admission control happens at ``submit`` time, before anything is queued:
   enqueueing. The budget is returned when the window drains, so
   backpressure releases after a flush.
 
+The batcher is farm-implementation-agnostic: fronting a process-worker
+``MeshFarm`` (PR 12, ``mesh_backend="process"``) changes nothing above.
+A worker crash mid-flush surfaces exactly like any mid-window poisoning:
+the dispatch quarantines the crashed shard's in-flight docs under
+``WorkerCrashError``, the flush report's ``quarantined_docs`` diff picks
+them up, their entries are never acked, and clients retry after
+``release_quarantine`` (the respawned worker re-hydrates from the
+controller's delivery log first). The per-submit quarantine check stays
+cheap because the process controller answers ``farm.quarantine`` from
+its local mirror — zero worker round trips on the admission path (pinned
+by tests/test_mesh_workers.py).
+
+
 Everything is driven by the injected clock (``clock()`` in simulated or
 real seconds) — no wall-clock reads, no sleeps, no blocking calls (amlint
 AM402/AM403): the event loop or harness decides when ``flush`` runs.

@@ -1,0 +1,14 @@
+# amlint: mesh-worker — fixture: injected sinks keep worker code clean
+
+
+def serve_shard(conn, farm, registry):
+    """The blessed worker shape: the farm and the metrics sink arrive as
+    arguments; results and metric deltas ship back over the pipe."""
+    last = registry.frame()
+    op, payload = conn.recv()
+    result = farm.apply_changes(payload)
+    delta = {
+        name: entry for name, entry in registry.frame().items()
+        if entry != last.get(name)
+    }
+    conn.send(("ok", result, delta))

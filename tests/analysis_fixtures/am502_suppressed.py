@@ -1,0 +1,13 @@
+# amlint: mesh-worker — fixture: justified suppressions silence AM502
+
+
+def worker_main(conn):
+    """The one blessed global-registry pattern: the worker records into
+    ITS OWN process singleton and ships deltas over the pipe."""
+    # amlint: disable=AM502 — this is the worker process's own registry,
+    # used as the delta shipping buffer, never the controller's
+    from automerge_tpu.obs.metrics import get_metrics
+
+    metrics = get_metrics()  # amlint: disable=AM502 — same shipping buffer
+    metrics.enable()
+    conn.send(("ready", metrics.frame(), None))

@@ -1,0 +1,11 @@
+# amlint: mesh-worker — fixture: controller import + global registry (AM502)
+from automerge_tpu.obs.metrics import get_metrics
+from automerge_tpu.parallel.meshfarm import MeshFarm
+
+
+def serve_shard(spec):
+    """The forbidden worker shape: pulls the controller into the child
+    and records into the worker-process singleton, where the numbers
+    never surface."""
+    get_metrics().counter("mesh.worker.rpcs").inc()
+    return MeshFarm(spec["num_docs"])

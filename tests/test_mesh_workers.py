@@ -1,0 +1,217 @@
+"""Worker supervision, crash recovery and controller-policy tests for
+``mesh_backend="process"`` (parallel/workers.py + meshfarm.py).
+
+The crash tests use ``inject_worker_fault`` — the chaos hook that makes
+one worker SIGKILL itself, indistinguishable from an external kill -9 —
+and pin the full recovery contract: the mesh keeps serving, survivors'
+patches stay byte-identical to the inline oracle, the in-flight docs
+land in quarantine under ``WorkerCrashError`` (kind "worker_crash"),
+and after ``release_quarantine`` + re-delivery the recovered docs
+converge to the oracle too (the respawned worker was re-hydrated from
+the controller's delivery log).
+"""
+import json
+import multiprocessing
+import time
+
+import pytest
+
+from automerge_tpu.errors import WorkerCrashError, error_kind
+from automerge_tpu.opset import OpSet
+from automerge_tpu.parallel.meshfarm import MeshFarm
+from test_farm import Workload
+
+NUM_DOCS = 8
+NUM_SHARDS = 2
+ROUNDS = 6
+CRASH_ROUND = 2
+
+
+def _rounds(seed=3, rounds=ROUNDS):
+    gen = OpSet()
+    w = Workload(seed)
+    return [r for r in (w.next_round(gen) for _ in range(rounds)) if r]
+
+
+def _final_patches(mesh):
+    return [
+        json.dumps(mesh.get_patch(d), sort_keys=True)
+        for d in range(NUM_DOCS)
+    ]
+
+
+def _drive_inline(deliveries):
+    mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                    mesh_backend="inline")
+    try:
+        for buffers in deliveries:
+            mesh.apply_changes(
+                [list(buffers) for _ in range(NUM_DOCS)], isolation="doc"
+            )
+        return _final_patches(mesh)
+    finally:
+        mesh.close()
+
+
+def test_worker_crash_mid_delivery_recovers_to_oracle():
+    deliveries = _rounds()
+    oracle = _drive_inline(deliveries)
+    mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                    mesh_backend="process")
+    try:
+        for r, buffers in enumerate(deliveries):
+            per_doc = [list(buffers) for _ in range(NUM_DOCS)]
+            if r == CRASH_ROUND:
+                mesh.inject_worker_fault(1, when="next_apply")
+            res = mesh.apply_changes(per_doc, isolation="doc")
+            if r != CRASH_ROUND:
+                assert not res.quarantined
+                continue
+            # the delivery the worker died under: every shard-1 doc was
+            # in flight and is quarantined under the crash taxonomy...
+            q = res.quarantined
+            assert sorted(q) == sorted(
+                d for d in range(NUM_DOCS) if mesh.shard_of(d) == 1
+            )
+            for outcome in q.values():
+                assert isinstance(outcome.error, WorkerCrashError)
+                assert error_kind(outcome.error) == "worker_crash"
+            assert set(q) == set(mesh.quarantine)
+            # ...while shard 0's docs applied as if nothing happened
+            for d in range(NUM_DOCS):
+                if d not in q:
+                    assert res.outcomes[d].status == "applied"
+            # release + re-deliver the lost round: the respawned worker
+            # was re-hydrated from the delivery log, so this converges
+            assert sorted(mesh.release_quarantine()) == sorted(q)
+            redo = [per_doc[d] if d in q else [] for d in range(NUM_DOCS)]
+            redo_res = mesh.apply_changes(redo, isolation="doc")
+            assert all(o.status == "applied" for o in redo_res.outcomes)
+        assert _final_patches(mesh) == oracle
+        mesh.audit()
+    finally:
+        mesh.close()
+    assert multiprocessing.active_children() == []
+
+
+def test_heartbeat_detects_and_respawns_dead_worker():
+    mesh = MeshFarm(4, num_shards=NUM_SHARDS, capacity=16,
+                    mesh_backend="process")
+    try:
+        assert mesh.heartbeat() == {0: "ok", 1: "ok"}
+        mesh.inject_worker_fault(0, when="now")
+        deadline = time.monotonic() + 10.0
+        while mesh._handles[0].alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert mesh.heartbeat() == {0: "respawned", 1: "ok"}
+        assert mesh.heartbeat() == {0: "ok", 1: "ok"}
+    finally:
+        mesh.close()
+    assert multiprocessing.active_children() == []
+
+
+def test_migration_and_rebalance_over_the_pipe_match_inline():
+    def drive(backend):
+        mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                        mesh_backend=backend)
+        try:
+            for r, buffers in enumerate(_rounds(seed=5)):
+                mesh.apply_changes(
+                    [list(buffers) for _ in range(NUM_DOCS)],
+                    isolation="doc",
+                )
+                if r == 2:
+                    d = next(x for x in range(NUM_DOCS)
+                             if mesh.shard_of(x) == 0)
+                    mesh.migrate_doc(d, 1)
+                    mesh.audit()
+            mid = _final_patches(mesh)
+            mesh.rebalance(max_moves=1, min_gain_pages=0)
+            mesh.audit()
+            return mid, _final_patches(mesh)
+        finally:
+            mesh.close()
+
+    assert drive("inline") == drive("process")
+    assert multiprocessing.active_children() == []
+
+
+def test_dispatch_shards_reraises_first_shard_error_after_draining():
+    """The satellite regression: a mid-dispatch shard exception must
+    neither deadlock the pool nor abandon other shards' results, and the
+    FIRST failing shard (lowest id) surfaces with its id attached."""
+    import os
+    os.environ["AM_MESH_CONCURRENCY"] = "4"
+    try:
+        mesh = MeshFarm(9, num_shards=3, capacity=16, mesh_backend="inline")
+    finally:
+        del os.environ["AM_MESH_CONCURRENCY"]
+    try:
+        assert mesh._executor is not None
+        done = []
+
+        def fn(s):
+            done.append(s)
+            if s in (1, 2):
+                raise RuntimeError(f"boom shard {s}")
+            return s * 10
+
+        with pytest.raises(RuntimeError) as ei:
+            mesh._dispatch_shards([0, 1, 2], fn)
+        assert ei.value.shard == 1
+        assert ei.value.args[0].startswith("[shard 1]")
+        assert sorted(done) == [0, 1, 2]  # every future drained
+
+        # serial path (no pool): same drain-and-attribute contract
+        mesh._executor.shutdown(wait=True)
+        mesh._executor = None
+        done.clear()
+        with pytest.raises(RuntimeError) as ei:
+            mesh._dispatch_shards([0, 1, 2], fn)
+        assert ei.value.shard == 1
+        assert ei.value.args[0].startswith("[shard 1]")
+        assert sorted(done) == [0, 1, 2]
+    finally:
+        mesh.close()
+
+
+def test_quarantine_reads_are_rpc_free_on_process_backend():
+    """The serve batcher checks ``farm.quarantine`` on EVERY submit
+    (serve/batcher.py admission), so the process controller must answer
+    from its local mirror without a worker round trip."""
+    mesh = MeshFarm(4, num_shards=NUM_SHARDS, capacity=16,
+                    mesh_backend="process")
+    try:
+        calls = []
+        for h in mesh._handles:
+            orig = h.call
+            h.call = (lambda orig: lambda *a, **k: (
+                calls.append(a[0]), orig(*a, **k))[1])(orig)
+        for _ in range(50):
+            assert mesh.quarantine == {}
+        assert calls == []
+    finally:
+        mesh.close()
+    assert multiprocessing.active_children() == []
+
+
+def test_rebalance_policy_hook_is_called_on_interval():
+    calls = []
+    mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                    mesh_backend="inline",
+                    rebalance_policy=calls.append, rebalance_interval=2)
+    try:
+        gen = OpSet()
+        w = Workload(9)
+        applied = 0
+        while applied < 4:
+            buffers = w.next_round(gen)
+            if not buffers:
+                continue
+            mesh.apply_changes(
+                [list(buffers) for _ in range(NUM_DOCS)], isolation="doc"
+            )
+            applied += 1
+        assert calls == [mesh, mesh]
+    finally:
+        mesh.close()

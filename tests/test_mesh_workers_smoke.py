@@ -1,0 +1,89 @@
+"""Tier-1 smoke for the process-backed mesh (parallel/workers.py).
+
+Three machine-independent contracts:
+
+1. **Parity**: a 2-worker ``mesh_backend="process"`` farm converges to
+   byte-identical patches/outcomes/quarantine vs the inline backend (the
+   parity oracle) over a multi-round workload, including reconcile and a
+   clean ownership audit.
+2. **No leaks**: ``close()`` leaves zero live child processes.
+3. **Spawn safety**: importing ``automerge_tpu.parallel.workers`` must
+   NOT import jax or the farm — spawned children re-import the module
+   tree before applying env overrides, so a heavy import at module scope
+   would both slow every spawn and initialise jax with the wrong env.
+
+The heavy 8-shard soak is marked slow (``make mesh-workers`` runs the
+process bench at full fidelity).
+"""
+import json
+import multiprocessing
+import subprocess
+import sys
+
+import pytest
+
+from automerge_tpu.opset import OpSet
+from automerge_tpu.parallel.meshfarm import MeshFarm
+from test_farm import Workload
+
+NUM_DOCS = 8
+ROUNDS = 5
+
+
+def drive(backend, num_shards=2, seed=7, rounds=ROUNDS):
+    """Runs a deterministic workload and returns every observable byte:
+    per-round patches + outcome statuses, final patches, quarantine."""
+    mesh = MeshFarm(NUM_DOCS, num_shards=num_shards, capacity=64,
+                    mesh_backend=backend)
+    gen = OpSet()
+    w = Workload(seed)
+    outs = []
+    try:
+        for _ in range(rounds):
+            buffers = w.next_round(gen)
+            if not buffers:
+                continue
+            per_doc = [list(buffers) for _ in range(NUM_DOCS)]
+            res = mesh.apply_changes(per_doc, isolation="doc")
+            outs.append([
+                json.dumps(res[d], sort_keys=True) for d in range(NUM_DOCS)
+            ])
+            outs.append([o.status for o in res.outcomes])
+        outs.append([
+            json.dumps(mesh.get_patch(d), sort_keys=True)
+            for d in range(NUM_DOCS)
+        ])
+        outs.append(sorted(mesh.quarantine))
+        outs.append(mesh.reconcile_actors())
+        mesh.audit()
+    finally:
+        mesh.close()
+    return outs
+
+
+def test_process_backend_parity_and_clean_close():
+    inline = drive("inline")
+    process = drive("process")
+    assert inline == process
+    assert multiprocessing.active_children() == []
+
+
+@pytest.mark.slow
+def test_eight_shard_soak():
+    inline = drive("inline", num_shards=8, seed=11, rounds=12)
+    process = drive("process", num_shards=8, seed=11, rounds=12)
+    assert inline == process
+    assert multiprocessing.active_children() == []
+
+
+def test_workers_module_imports_without_jax():
+    """Pinned spawn-safety contract (see workers.py module docstring)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import automerge_tpu.parallel.workers; "
+         "assert 'jax' not in sys.modules, 'workers.py imported jax'; "
+         "assert 'automerge_tpu.tpu.farm' not in sys.modules, "
+         "    'workers.py imported the farm'"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
