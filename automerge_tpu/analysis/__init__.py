@@ -31,7 +31,10 @@ Rule families (see core.RULES for the catalog):
   (AM301), hidden host syncs inside device profiling phases (AM302),
   metric/span recording inside jit/vmap/Pallas-reachable code (AM303),
   metric/event names out of sync with the README observability catalog
-  in either direction (AM304).
+  in either direction (AM304); worker-executed modules reaching the
+  telemetry exposition/fan-in layer (``get_flight``, ``obs.export``) —
+  worker telemetry leaves the process only through the shipping buffer:
+  pipe deltas, shipped flight tails and the black-box file (AM305).
 - **AM4xx taxonomy/serve**: data-plane modules raising bare ValueError/
   TypeError instead of classifiable taxonomy errors (AM401); sync
   data-plane modules calling wall clocks or the global RNG directly

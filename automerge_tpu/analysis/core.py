@@ -67,6 +67,13 @@ RULES: dict[str, tuple[str, str]] = {
                           "from the README catalog, or a catalog row names "
                           "nothing the code records (the observability "
                           "contract must stay exact in both directions)"),
+    "AM305": ("boundary", "worker-executed module reaches the telemetry "
+                          "exposition/fan-in layer (get_flight, obs.export: "
+                          "render_exposition/serve_exposition/"
+                          "snapshot_record/SnapshotWriter) — worker "
+                          "telemetry leaves the process only through the "
+                          "shipping buffer: pipe deltas, shipped flight "
+                          "tails and the black-box file"),
     "AM401": ("taxonomy", "bare ValueError/TypeError raised in a data-plane "
                           "module (raise a classifiable taxonomy error from "
                           "automerge_tpu.errors)"),

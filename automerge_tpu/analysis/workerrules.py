@@ -1,5 +1,6 @@
-"""AM502 — mesh worker hygiene: no controller imports, no process-global
-registry access in worker-executed modules.
+"""AM502 + AM305 — mesh worker hygiene: no controller imports, no
+process-global registry access, no exposition-layer telemetry in
+worker-executed modules.
 
 A mesh worker (parallel/workers.py) is spawned — not forked — so the
 child re-imports its module tree under a pristine interpreter. Two bug
@@ -25,16 +26,29 @@ stacks:
 
 Flagged in scope:
 
-- ``import``/``from ... import`` whose module path contains a
+- AM502: ``import``/``from ... import`` whose module path contains a
   controller-only segment (``meshfarm`` or ``serve``), or that imports
   such a module by name from a package;
-- importing or calling a process-global registry accessor
+- AM502: importing or calling a process-global registry accessor
   (``get_metrics``, ``get_flight``, ``get_amscope``, ``get_trace``,
   ``get_profile``).
+- AM305: reaching the telemetry exposition/fan-in layer — importing
+  ``obs.export`` (or any of ``render_exposition`` /
+  ``serve_exposition`` / ``snapshot_record`` / ``SnapshotWriter`` by
+  name), calling one of those, or importing/calling ``get_flight``.
+  A worker's telemetry leaves its process exactly three ways, all
+  shipping-buffer shaped: metric ``diff_frames`` deltas on the pipe,
+  ``FlightRecorder.ship()`` event tails on the pipe, and the bounded
+  black-box file for crash forensics. Exposing a worker's own registry
+  on an exposition page (or snapshotting it to JSONL) publishes numbers
+  the controller never sees — the split-brain telemetry bug. The one
+  blessed pattern (the worker's own singleton AS the shipping buffer)
+  carries a justified ``# amlint: disable=AM502,AM305`` suppression.
 
-Scope: modules whose filename stem is in ``WORKER_STEMS``, plus any file
-carrying a ``# amlint: mesh-worker`` marker (the fixture hook, and the
-opt-in for future worker-executed modules living elsewhere).
+Scope (both rules): modules whose filename stem is in ``WORKER_STEMS``,
+plus any file carrying a ``# amlint: mesh-worker`` marker (the fixture
+hook, and the opt-in for future worker-executed modules living
+elsewhere).
 """
 from __future__ import annotations
 
@@ -55,6 +69,13 @@ CONTROLLER_SEGMENTS = frozenset({"meshfarm", "serve"})
 #: process-global registry accessors (obs + profiling singletons)
 GLOBAL_ACCESSORS = frozenset({
     "get_metrics", "get_flight", "get_amscope", "get_trace", "get_profile",
+})
+
+#: exposition/fan-in layer names a worker must never touch (AM305):
+#: publishing a worker's own registry bypasses the shipping buffer
+EXPOSITION_NAMES = frozenset({
+    "render_exposition", "serve_exposition", "snapshot_record",
+    "SnapshotWriter",
 })
 
 
@@ -85,6 +106,24 @@ def _imported_accessors(node: ast.AST) -> set[str]:
     return set()
 
 
+def _exposition_import(node: ast.AST) -> set[str]:
+    """Exposition-layer names this import drags into a worker module:
+    the ``obs.export`` module itself, or any ``EXPOSITION_NAMES`` member
+    imported by name."""
+    if isinstance(node, ast.Import):
+        return {
+            alias.name for alias in node.names
+            if "export" in alias.name.split(".")
+        }
+    if isinstance(node, ast.ImportFrom):
+        if "export" in (node.module or "").split("."):
+            return {node.module or "export"}
+        return EXPOSITION_NAMES & {alias.name for alias in node.names} | {
+            alias.name for alias in node.names if alias.name == "export"
+        }
+    return set()
+
+
 def check(ctxs: list[FileContext]) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
@@ -110,6 +149,27 @@ def check(ctxs: list[FileContext]) -> list[Finding]:
                     f"sinks explicitly, or justify the record-locally/"
                     f"ship-deltas pattern with a suppression",
                 ))
+                if "get_flight" in imported:
+                    findings.append(ctx.finding(
+                        "AM305", node,
+                        "worker-executed module imports get_flight: worker "
+                        "flight events leave the process only as shipped "
+                        "tails (FlightRecorder.ship() over the pipe) or "
+                        "the black-box file — justify the shipping-buffer "
+                        "pattern with a suppression",
+                    ))
+                continue
+            exposition = _exposition_import(node)
+            if exposition:
+                findings.append(ctx.finding(
+                    "AM305", node,
+                    f"worker-executed module imports the telemetry "
+                    f"exposition layer ({sorted(exposition)}): exposing a "
+                    f"worker's own registry publishes numbers the "
+                    f"controller never sees — telemetry ships over the "
+                    f"pipe (metric deltas + flight tails) or the "
+                    f"black-box file only",
+                ))
                 continue
             if isinstance(node, ast.Call):
                 name = dotted_name(node.func)
@@ -123,5 +183,21 @@ def check(ctxs: list[FileContext]) -> list[Finding]:
                         f"inject sinks explicitly, or justify the "
                         f"record-locally/ship-deltas pattern with a "
                         f"suppression",
+                    ))
+                if leaf == "get_flight":
+                    findings.append(ctx.finding(
+                        "AM305", node,
+                        "worker-executed module calls get_flight(): worker "
+                        "flight events leave the process only as shipped "
+                        "tails or the black-box file — justify the "
+                        "shipping-buffer pattern with a suppression",
+                    ))
+                elif leaf in EXPOSITION_NAMES:
+                    findings.append(ctx.finding(
+                        "AM305", node,
+                        f"worker-executed module calls exposition-layer "
+                        f"{leaf}(): a worker must not publish its own "
+                        f"registry — telemetry ships over the pipe or the "
+                        f"black-box file only",
                     ))
     return findings

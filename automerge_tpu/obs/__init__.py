@@ -23,10 +23,18 @@ Five parts plus a CLI:
 - **Flight recorder** (`obs.flight`): a bounded ring of structured events
   (retransmits, watchdog escalations, quarantine transitions, flush
   decisions, recompiles, slab growth), snapshot-dumped to JSONL on
-  faults for postmortems without re-running the workload.
+  faults for postmortems without re-running the workload. Mesh workers
+  ship their shard-tagged event tails over the result pipe into the
+  controller's unified timeline, and persist a bounded black-box file
+  for crash forensics that survive a SIGKILL.
 - **Live telemetry** (`obs.export`): Prometheus-style text exposition
   (mounted on the asyncio adapter's telemetry port), periodic JSONL
   snapshots, and the per-request phase-share math.
+- **SLOs** (`obs.slo`): declared objectives (latency percentile under
+  budget, availability, convergence ratio) evaluated as multi-window
+  burn rates on an injected clock — simulated and wall clocks both
+  work — exported as ``slo.*`` gauges and verdict dicts that gate the
+  serve/mesh benches.
 - **CLI**: ``python -m automerge_tpu.obs`` runs a canned farm merge + sync
   round-trip (or reads a dumped JSONL trace); ``--flight`` renders a
   flight-recorder dump as a causal timeline; ``--watch`` renders live
@@ -58,6 +66,14 @@ from .scope import (
     enabled_amscope,
     get_amscope,
 )
+from .slo import (
+    Objective,
+    SLOEngine,
+    availability_objective,
+    latency_objective,
+    ratio_objective,
+    verdicts_ok,
+)
 from .spans import SpanNode, Trace, get_trace, use_trace
 
 __all__ = [
@@ -68,9 +84,12 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Objective",
     "RequestScope",
+    "SLOEngine",
     "SpanNode",
     "Trace",
+    "availability_objective",
     "enabled_amscope",
     "enabled_flight",
     "enabled_metrics",
@@ -79,7 +98,10 @@ __all__ = [
     "get_flight",
     "get_metrics",
     "get_trace",
+    "latency_objective",
+    "ratio_objective",
     "use_trace",
+    "verdicts_ok",
 ]
 
 

@@ -199,6 +199,13 @@ def _render_watch_frame(record: dict) -> str:
                 else:
                     cells.append("-" if v is None else str(v))
             lines.append("  ".join([f"{shard:>5}"] + [f"{c:>18}" for c in cells]))
+    slo = record.get("slo")
+    if slo:
+        from .slo import render_verdicts
+
+        lines.append("")
+        lines.append("-- SLOs --")
+        lines.append(render_verdicts(slo))
     lines.append("")
     lines.append("-- flight tail --")
     tail = record.get("flight_tail", [])

@@ -58,11 +58,23 @@ def _sanitize(name: str) -> str:
     return "".join(out)
 
 
-def render_exposition(registry=None, scope=None) -> str:
-    """The pull-based text page: metrics + per-tenant samples."""
+def render_exposition(registry=None, scope=None, slo=None) -> str:
+    """The pull-based text page: metrics + per-tenant samples. ``slo``
+    (a verdict list from ``obs.slo.SLOEngine.evaluate``) adds one
+    ``# SLO`` comment line per objective window — the ``slo.*`` gauges
+    the engine exports appear as ordinary samples regardless."""
     registry = registry if registry is not None else get_metrics()
     scope = scope if scope is not None else get_amscope()
     lines: list[str] = []
+    for v in slo or ():
+        for w in v["windows"]:
+            burn = w["burn_rate"]
+            lines.append(
+                f"# SLO {_sanitize(v['objective'])} target={v['target']:.6g}"
+                f" window={w['window_s']:.6g}s"
+                f" burn={'-' if burn is None else f'{burn:.6g}'}"
+                f" {'ok' if v['ok'] else 'BREACH'}"
+            )
     for name, snap in registry.as_dict().items():
         n = _sanitize(name)
         if snap["type"] == "histogram":
@@ -204,30 +216,39 @@ def shard_table(metrics_snapshot: dict) -> dict:
 
 
 def snapshot_record(t: float | None = None, registry=None, scope=None,
-                    flight=None, tail: int = 16) -> dict:
-    """One self-contained telemetry snapshot (a JSONL line's payload)."""
+                    flight=None, tail: int = 16, slo=None) -> dict:
+    """One self-contained telemetry snapshot (a JSONL line's payload).
+    ``slo`` verdicts (when an engine is wired) ride along for the
+    ``--watch`` SLO panel."""
     registry = registry if registry is not None else get_metrics()
     scope = scope if scope is not None else get_amscope()
     flight = flight if flight is not None else get_flight()
     metrics = registry.as_dict()
-    return {
+    record = {
         "t": time.time() if t is None else t,
         "metrics": metrics,
         "tenants": scope.tenant_stats(),
         "breakdown": request_breakdown(metrics),
         "flight_tail": flight.tail(tail),
     }
+    if slo is not None:
+        record["slo"] = slo
+    return record
 
 
 class SnapshotWriter:
     """Appends periodic JSONL snapshots to a file. Clock-injected so the
     load harness snapshots on simulated time; ``serve_forever`` drives it
-    from its flusher task on the real clock."""
+    from its flusher task on the real clock. An attached ``slo_engine``
+    is evaluated (and its ``slo.*`` gauges exported) at every write, so
+    each snapshot line carries the verdicts as of that tick."""
 
-    def __init__(self, path: str, interval: float = 5.0, clock=None):
+    def __init__(self, path: str, interval: float = 5.0, clock=None,
+                 slo_engine=None):
         self.path = path
         self.interval = interval
         self.clock = clock if clock is not None else time.monotonic
+        self.slo_engine = slo_engine
         self._last: float | None = None
 
     def maybe_write(self, now: float | None = None) -> bool:
@@ -240,7 +261,11 @@ class SnapshotWriter:
     def write(self, now: float | None = None) -> None:
         now = self.clock() if now is None else now
         self._last = now
-        record = snapshot_record(t=now)
+        verdicts = (
+            self.slo_engine.export(now=now)
+            if self.slo_engine is not None else None
+        )
+        record = snapshot_record(t=now, slo=verdicts)
         with open(self.path, "a", encoding="utf-8") as fh:
             fh.write(json.dumps(record, sort_keys=True, default=str) + "\n")
 

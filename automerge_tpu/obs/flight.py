@@ -23,11 +23,22 @@ page-slab growth) into one process-wide ring buffer:
   quarantine entry and device faults, the session layer on channel
   quarantine and watchdog resets — so a `DeviceFaultError` at 3am leaves
   a timeline behind, not just counters.
+- **mesh-mergeable**: a recorder can be tagged with a ``(shard, epoch)``
+  origin (mesh workers are; ``epoch`` is the spawn generation, so a
+  respawned worker's restarted local seq cannot collide with its previous
+  life). Workers ``ship()`` their unshipped tail over the result pipe and
+  the controller ``absorb()``\\s it into the unified timeline, assigning
+  fresh controller seqs while preserving the origin key ``(epoch, shard,
+  wseq)``. Merged dumps therefore order deterministically: controller seq
+  first, origin key as the tiebreaker when independently-numbered dumps
+  are concatenated. Workers also ``write_blackbox()`` a bounded file
+  (flight tail + last phase profile) after every delivery, so a
+  SIGKILLed worker's final events survive for crash forensics.
 
 ``python -m automerge_tpu.obs --flight <dump.jsonl>`` renders a dump as a
-causally-ordered timeline. The event-name catalog lives in the README
-"Observability" section and is cross-checked against the code by amlint
-rule AM304.
+causally-ordered timeline (with a shard column once any event carries an
+origin). The event-name catalog lives in the README "Observability"
+section and is cross-checked against the code by amlint rule AM304.
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
@@ -43,21 +54,28 @@ from typing import Iterator
 DEFAULT_CAPACITY = 4096
 #: auto-dump files per process: a quarantine storm must not fill a disk
 MAX_AUTO_DUMPS = 8
+#: events preserved in a worker's black-box file (bounded on disk)
+BLACKBOX_TAIL = 64
 
 
 class FlightRecorder:
     """One process-wide ring of structured events. See module docstring."""
 
-    __slots__ = ("enabled", "clock", "dump_dir", "dump_paths", "_ring",
-                 "_seq")
+    __slots__ = ("enabled", "clock", "dump_dir", "dump_paths", "shard",
+                 "epoch", "_ring", "_seq", "_shipped")
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=None):
         self.enabled = False
         self.clock = clock if clock is not None else time.monotonic
         self.dump_dir = os.environ.get("AM_FLIGHT_DIR") or None
         self.dump_paths: list[str] = []
+        #: origin tag for mesh workers; None on the controller / solo host
+        self.shard: int | None = None
+        #: spawn generation of the tagged worker (bumped on respawn)
+        self.epoch = 0
         self._ring: deque = deque(maxlen=capacity)
         self._seq = 0
+        self._shipped = 0
 
     # -------------------------------------------------------------- #
     # recording
@@ -96,14 +114,69 @@ class FlightRecorder:
         return path
 
     # -------------------------------------------------------------- #
+    # the mesh telemetry channel: worker ship -> controller absorb
+
+    def ship(self) -> list[dict]:
+        """The unshipped tail as event dicts, advancing the ship mark.
+
+        This is the flight half of the worker shipping buffer: called once
+        per pipe response (result frames and heartbeats alike) and sent
+        alongside the ``metrics_delta``. Cheap when idle or disabled: a
+        counter compare, no allocation. Events that fell off the bounded
+        ring before shipping are lost by design (same budget as dumps)."""
+        if self._seq == self._shipped:
+            return []
+        mark = self._shipped
+        self._shipped = self._seq
+        return [e for e in self.snapshot() if e["seq"] > mark]
+
+    def absorb(self, events: list[dict], dedup: bool = False) -> int:
+        """Merges shipped (or black-box-recovered) worker events into this
+        ring, assigning fresh controller seqs so the unified timeline has
+        one total order; each event keeps its origin key ``(shard, epoch,
+        wseq)`` and the worker's own clock reading. ``dedup=True`` (the
+        black-box recovery path) skips events whose origin key is already
+        in the ring — the worker may have live-shipped part of its tail
+        before dying. No-op when disabled. Returns the absorbed count."""
+        if not self.enabled:
+            return 0
+        seen = (
+            {entry[4] for entry in self._ring if len(entry) == 5}
+            if dedup else None
+        )
+        absorbed = 0
+        for e in events:
+            origin = (e.get("shard"), e.get("epoch", 0),
+                      e.get("wseq", e.get("seq", 0)))
+            if seen is not None and origin in seen:
+                continue
+            self._seq += 1
+            absorbed += 1
+            self._ring.append(
+                (self._seq, e.get("t", 0.0), e.get("event", ""),
+                 e.get("fields") or {}, origin)
+            )
+        return absorbed
+
+    # -------------------------------------------------------------- #
     # reading
 
     def snapshot(self) -> list[dict]:
-        """The ring as a list of dicts, oldest first (causal order)."""
-        return [
-            {"seq": seq, "t": t, "event": kind, "fields": fields}
-            for seq, t, kind, fields in self._ring
-        ]
+        """The ring as a list of dicts, oldest first (causal order).
+
+        Untagged recorders (the single-process case) produce exactly the
+        pre-mesh shape; shard-tagged recorders and absorbed worker events
+        add ``shard``/``epoch``/``wseq`` origin keys."""
+        out = []
+        for entry in self._ring:
+            seq, t, kind, fields = entry[:4]
+            e = {"seq": seq, "t": t, "event": kind, "fields": fields}
+            if len(entry) == 5:  # absorbed from a worker
+                e["shard"], e["epoch"], e["wseq"] = entry[4]
+            elif self.shard is not None:  # this recorder IS a worker's
+                e["shard"], e["epoch"], e["wseq"] = self.shard, self.epoch, seq
+            out.append(e)
+        return out
 
     def tail(self, n: int = 16) -> list[dict]:
         """The newest ``n`` events (causal order within the slice)."""
@@ -131,32 +204,93 @@ class FlightRecorder:
 # ---------------------------------------------------------------------- #
 # dump loading + timeline rendering (the `--flight` CLI path)
 
+def _merge_key(e: dict) -> tuple:
+    """Deterministic order for merged multi-process timelines: primary is
+    the (controller) seq — identical to the pre-mesh sort for
+    single-process dumps — tie-broken by the origin key ``(epoch, shard,
+    local_seq)`` so independently-numbered dumps concatenated together
+    (e.g. a controller dump plus a dead worker's black box) interleave
+    without per-process seq collisions scrambling the order."""
+    shard = e.get("shard")
+    return (e.get("seq", 0), e.get("epoch", 0),
+            -1 if shard is None else shard, e.get("wseq", 0))
+
+
 def load_jsonl(text: str) -> list[dict]:
-    """Parses a dump back into event dicts, sorted causally by seq (so
-    concatenated dumps interleave correctly)."""
+    """Parses a dump back into event dicts, sorted causally (see
+    ``_merge_key``; plain single-process dumps sort by seq exactly as
+    before)."""
     events = []
     for line in text.splitlines():
         line = line.strip()
         if line:
             events.append(json.loads(line))
-    events.sort(key=lambda e: e.get("seq", 0))
+    events.sort(key=_merge_key)
     return events
 
 
 def render_timeline(events: list[dict]) -> str:
-    """Causally-ordered human-readable timeline of a dump."""
+    """Causally-ordered human-readable timeline of a dump. A shard column
+    appears once any event carries a mesh origin tag (controller-local
+    rows show ``-``); untagged dumps render byte-identically to the
+    pre-mesh format."""
     if not events:
         return "(no flight events)"
     width = max(len(e.get("event", "")) for e in events)
-    lines = [f"{'seq':>6}  {'t':>12}  {'event'.ljust(width)}  fields"]
+    tagged = any("shard" in e for e in events)
+    header = f"{'seq':>6}  "
+    if tagged:
+        header += f"{'shard':>5}  "
+    header += f"{'t':>12}  {'event'.ljust(width)}  fields"
+    lines = [header]
     for e in events:
         fields = e.get("fields") or {}
         detail = " ".join(f"{k}={fields[k]}" for k in sorted(fields))
-        lines.append(
-            f"{e.get('seq', 0):>6}  {e.get('t', 0.0):>12.6f}  "
+        row = f"{e.get('seq', 0):>6}  "
+        if tagged:
+            shard = e.get("shard")
+            row += f"{'-' if shard is None else shard:>5}  "
+        row += (
+            f"{e.get('t', 0.0):>12.6f}  "
             f"{e.get('event', '').ljust(width)}  {detail}"
         )
+        lines.append(row)
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------- #
+# worker black box: crash forensics that survive a SIGKILL
+
+def write_blackbox(path: str, recorder: FlightRecorder,
+                   phases_jsonl: str = "") -> None:
+    """Persists a bounded black-box file: the recorder's flight tail
+    (shard-tagged) plus the last delivery's phase profile. Written
+    atomically (tmp + rename) after every worker delivery and on the
+    worker fault path, so the file a crashed worker leaves behind is
+    always a complete JSON document — a SIGKILL between deliveries cannot
+    tear it."""
+    payload = {
+        "pid": os.getpid(),
+        "shard": recorder.shard,
+        "epoch": recorder.epoch,
+        "events": recorder.tail(BLACKBOX_TAIL),
+        "phases": phases_jsonl,
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, sort_keys=True, default=str)
+    os.replace(tmp, path)
+
+
+def read_blackbox(path: str) -> dict | None:
+    """Loads a black-box file; None when absent or torn (best-effort by
+    contract — the writer may have died before its first delivery)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
 
 
 # ---------------------------------------------------------------------- #

@@ -185,6 +185,12 @@ class MetricsRegistry:
     def histogram(self, name: str, help: str = "") -> Histogram:
         return self._get(Histogram, name, help)
 
+    def find(self, name: str):
+        """Read-only lookup: the registered instrument, or None. Unlike
+        the typed getters this never registers — readers (the SLO engine,
+        exposition renderers) must not invent instruments."""
+        return self._instruments.get(name)
+
     # ------------------------------------------------------------------ #
 
     def enable(self) -> None:
@@ -222,13 +228,16 @@ class MetricsRegistry:
     def frame(self) -> dict:
         """{name: (kind, help, payload)} snapshot of raw instrument state
         (picklable, no instrument objects). Counter/gauge payload is the
-        value; histogram payload is (buckets, count, sum)."""
+        value; histogram payload is (buckets, count, sum, exemplars) —
+        exemplars ride along so a worker-stamped trace id survives the
+        trip back to the controller registry."""
         out = {}
         for name, inst in self._instruments.items():
             if isinstance(inst, Histogram):
                 out[name] = (
                     "histogram", inst.help,
-                    (dict(inst.buckets), inst.count, inst.sum),
+                    (dict(inst.buckets), inst.count, inst.sum,
+                     dict(inst.exemplars)),
                 )
             elif isinstance(inst, Gauge):
                 out[name] = ("gauge", inst.help, inst.value)
@@ -238,20 +247,24 @@ class MetricsRegistry:
 
     def merge_frame(self, frame: dict) -> None:
         """Accumulates a (delta) frame into this registry: counters are
-        inc'd, histogram buckets/count/sum are added, gauges are set.
-        Instruments are registered on first sight with the frame's help
-        text. No-op while the registry is disabled (instruments drop the
-        records anyway; skipping keeps disabled-path cost flat)."""
+        inc'd, histogram buckets/count/sum are added (bucket exemplars:
+        last writer wins, like gauges), gauges are set. Instruments are
+        registered on first sight with the frame's help text. No-op while
+        the registry is disabled (instruments drop the records anyway;
+        skipping keeps disabled-path cost flat)."""
         if not self.enabled:
             return
         for name, (kind, help, payload) in sorted(frame.items()):
             if kind == "histogram":
                 h = self.histogram(name, help)
-                buckets, count, sum_ = payload
+                buckets, count, sum_, exemplars = payload
                 for b, c in buckets.items():
                     h.buckets[b] = h.buckets.get(b, 0) + c
                 h.count += count
                 h.sum += sum_
+                for b, e in exemplars.items():
+                    if e is not None:
+                        h.exemplars[b] = e
             elif kind == "gauge":
                 self.gauge(name, help).set(payload)
             else:
@@ -318,15 +331,22 @@ def diff_frames(current: dict, previous: dict) -> dict:
             if prev is None or payload != prev[2]:
                 out[name] = (kind, help, payload)
         else:
-            buckets, count, sum_ = payload
-            pb, pc, ps = prev[2] if prev else ({}, 0, 0.0)
+            buckets, count, sum_, exemplars = payload
+            pb, pc, ps, pe = prev[2] if prev else ({}, 0, 0.0, {})
             if count != pc:
                 delta = {
                     b: c - pb.get(b, 0)
                     for b, c in buckets.items()
                     if c != pb.get(b, 0)
                 }
-                out[name] = (kind, help, (delta, count - pc, sum_ - ps))
+                # ship only exemplars that changed (or are new) since the
+                # last frame: the steady-state delta stays small
+                ex_delta = {
+                    b: e for b, e in exemplars.items() if e != pe.get(b)
+                }
+                out[name] = (
+                    kind, help, (delta, count - pc, sum_ - ps, ex_delta)
+                )
     return out
 
 

@@ -396,9 +396,14 @@ _CURRENT_DISPATCH: contextvars.ContextVar = contextvars.ContextVar(
 
 
 def current_exemplar():
-    """The ambient dispatch span id (None outside a serve dispatch)."""
+    """The ambient dispatch span id (None outside a serve dispatch). The
+    ambient value is either a full ``DispatchSpan`` (controller side) or a
+    bare span-id string restored from the fan-out payload inside a mesh
+    worker (``exemplar_context``) — both stamp the same id."""
     span = _CURRENT_DISPATCH.get()
-    return None if span is None else span.dispatch_id
+    if span is None:
+        return None
+    return span if isinstance(span, str) else span.dispatch_id
 
 
 @contextlib.contextmanager
@@ -406,6 +411,20 @@ def dispatch_context(span: DispatchSpan) -> Iterator[DispatchSpan]:
     token = _CURRENT_DISPATCH.set(span)
     try:
         yield span
+    finally:
+        _CURRENT_DISPATCH.reset(token)
+
+
+@contextlib.contextmanager
+def exemplar_context(dispatch_id: str | None) -> Iterator[str | None]:
+    """Worker-side trace propagation: restores a controller span id (as
+    shipped in the apply fan-out payload) as the ambient exemplar, so the
+    worker farm's ``farm.dispatch.latency_ms``/``farm.readback.latency_ms``
+    observations stamp the controller's dispatch id without importing any
+    controller state. ``None`` is a clean no-op ambient."""
+    token = _CURRENT_DISPATCH.set(dispatch_id)
+    try:
+        yield dispatch_id
     finally:
         _CURRENT_DISPATCH.reset(token)
 

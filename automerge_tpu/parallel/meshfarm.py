@@ -68,13 +68,14 @@ import contextlib
 import contextvars
 import os
 import pickle
+import tempfile
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from ..errors import PackingLimitError, WorkerCrashError, error_kind
-from ..obs.flight import get_flight
+from ..obs.flight import get_flight, read_blackbox
 from ..obs.metrics import get_metrics
 from ..obs.scope import current_exemplar
 from ..profiling import get_profile
@@ -130,7 +131,28 @@ _M_W_LOST = _METRICS.counter(
     "mesh.worker.lost_docs",
     "in-flight documents quarantined because their worker crashed",
 )
+_M_TELEMETRY_EVENTS = _METRICS.counter(
+    "mesh.telemetry.events",
+    "worker flight events absorbed into the controller timeline",
+)
+_M_TELEMETRY_RECOVERED = _METRICS.counter(
+    "mesh.telemetry.blackbox.recovered",
+    "dead-worker black-box files recovered into crash dumps",
+)
 _FLIGHT = get_flight()
+
+
+#: monotonic suffix for black-box paths (parallel meshes in one process)
+_BB_SEQ = 0
+
+
+def _absorb_worker_events(events) -> None:
+    """The controller end of the flight telemetry channel: shipped worker
+    event tails merge into the controller's unified timeline with fresh
+    controller seqs (origin keys preserved). Injected into every
+    ``WorkerHandle`` as ``on_flight``."""
+    _M_TELEMETRY_EVENTS.inc(len(events))
+    _FLIGHT.absorb(events)
 
 # per-shard instrument families, registered lazily on first touch (the
 # farm.quarantine.causes.<kind> idiom): full-literal-prefix names so the
@@ -385,7 +407,8 @@ class MeshFarm:
             specs.append(dict(
                 shard=s, num_docs=len(mine) + spare_slots,
                 capacity=capacity, quarantine_threshold=quarantine_threshold,
-                page_size=page_size, env=(),
+                page_size=page_size, env=(), epoch=0,
+                blackbox_path=self._blackbox_path(s),
                 warm_buffers=tuple(warm_changes) if warm_changes else None,
             ))
         if mesh_backend == "process":
@@ -395,6 +418,7 @@ class MeshFarm:
                 WorkerHandle(
                     spec, timeout=worker_timeout, defer_ready=True,
                     on_delta=_METRICS.merge_frame, on_rpc=_M_W_RPCS.inc,
+                    on_flight=_absorb_worker_events,
                 )
                 for spec in specs
             ]
@@ -431,6 +455,20 @@ class MeshFarm:
     # ------------------------------------------------------------------ #
     # routing
 
+    @staticmethod
+    def _blackbox_path(s: int) -> str:
+        """Where shard ``s``'s worker persists its black box: the flight
+        dump dir when one is configured (crash forensics land next to the
+        crash dumps), the system temp dir otherwise. Unique per
+        controller pid + spec so parallel meshes never collide; stable
+        across respawns so recovery always knows where to look."""
+        global _BB_SEQ
+        _BB_SEQ += 1
+        base = _FLIGHT.dump_dir or tempfile.gettempdir()
+        return os.path.join(
+            base, f"am-blackbox-{os.getpid()}-{_BB_SEQ:04d}-s{s}.json"
+        )
+
     def _device_ctx(self, s: int):
         if self._devices is None or self.backend == "process":
             return contextlib.nullcontext()
@@ -453,10 +491,16 @@ class MeshFarm:
 
     def close(self) -> None:
         """Shuts every worker down cleanly (ack'd shutdown, join,
-        terminate stragglers) and releases the dispatch pool. Idempotent;
-        leaves zero child processes behind."""
+        terminate stragglers), removes the workers' black-box files and
+        releases the dispatch pool. Idempotent; leaves zero child
+        processes behind."""
         for h in self._handles:
             h.close()
+            path = getattr(h, "spec", {}).get("blackbox_path") \
+                if not isinstance(h, _InlineShard) else None
+            if path:
+                with contextlib.suppress(OSError):
+                    os.remove(path)
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
@@ -521,7 +565,9 @@ class MeshFarm:
         shard_of, local_of = self._shard_of, self._local_of
         active = [d for d, bufs in enumerate(per_doc_buffers) if bufs]
         np.add.at(self._doc_dispatches, active, 1)
-        touched = sorted({shard_of[d] for d in active})
+        # plain ints: shard ids flow into flight-event fields and JSON
+        # dumps, where a stray np.int64 would stringify
+        touched = sorted({int(shard_of[d]) for d in active})
         counts = {
             s: sum(1 for d in active if shard_of[d] == s) for s in touched
         }
@@ -596,6 +642,14 @@ class MeshFarm:
         attached, exactly like the inline dispatch path."""
         shard_of, local_of = self._shard_of, self._local_of
         want_phases = bool(get_profile().enabled)
+        # the obs leg: the flight-enable bit mirrors this controller's
+        # recorder into the worker, and the ambient DispatchSpan id rides
+        # along so worker-side farm.dispatch/readback observations stamp
+        # the controller's trace ids. None when observability is off — the
+        # disabled path ships nothing extra.
+        obs = None
+        if _FLIGHT.enabled or _METRICS.enabled:
+            obs = {"flight": _FLIGHT.enabled, "exemplar": current_exemplar()}
         groups = {s: [] for s in touched}
         for d in active:
             groups[shard_of[d]].append(
@@ -605,8 +659,9 @@ class MeshFarm:
         crashed = {}
         for s in touched:
             try:
-                self._handles[s].request("apply",
-                                         (groups[s], is_local, want_phases))
+                self._handles[s].request(
+                    "apply", (groups[s], is_local, want_phases, obs)
+                )
                 sent.append(s)
             except WorkerCrashError as exc:
                 crashed[s] = exc
@@ -689,18 +744,42 @@ class MeshFarm:
         }
 
     def _recover_worker(self, s: int, in_flight, cause, phase: str):
-        """Crash recovery: respawn shard `s`'s worker, re-hydrate its
-        committed state by replaying the controller's per-doc delivery
-        log, re-impose surviving quarantines, and quarantine the docs
-        whose delivery was in flight when the worker died (taxonomy:
-        ``WorkerCrashError``, kind "worker_crash"). Returns
-        {global doc: DocOutcome} for the in-flight docs."""
+        """Crash recovery: recover the dead worker's black box into the
+        flight timeline and trigger the ``mesh.worker.crash`` dump, then
+        respawn shard `s`'s worker, re-hydrate its committed state by
+        replaying the controller's per-doc delivery log, re-impose
+        surviving quarantines, and quarantine the docs whose delivery was
+        in flight when the worker died (taxonomy: ``WorkerCrashError``,
+        kind "worker_crash"). Returns {global doc: DocOutcome} for the
+        in-flight docs."""
         h = self._handles[s]
         old_pid = h.pid
+        heartbeat_age = h.heartbeat_age()
         _M_W_CRASHES.inc()
         if _FLIGHT.enabled:
-            _FLIGHT.record("mesh.worker.crash", shard=s, pid=old_pid,
-                           phase=phase, cause=str(cause))
+            # black-box forensics BEFORE respawn (the fresh incarnation
+            # will start rewriting the same path): absorb the dead
+            # worker's final shard-tagged events, deduped against what it
+            # already shipped live, then dump the merged timeline
+            bb_path = h.spec.get("blackbox_path")
+            blackbox = read_blackbox(bb_path) if bb_path else None
+            recovered = 0
+            if blackbox:
+                recovered = _FLIGHT.absorb(
+                    blackbox.get("events", ()), dedup=True
+                )
+                _M_TELEMETRY_RECOVERED.inc()
+            _FLIGHT.record(
+                "mesh.worker.crash", shard=s, pid=old_pid, phase=phase,
+                cause=str(cause),
+                heartbeat_age_s=(
+                    None if heartbeat_age is None
+                    else round(heartbeat_age, 3)
+                ),
+                blackbox=bb_path if blackbox else None,
+                blackbox_events=recovered,
+            )
+            _FLIGHT.trigger("mesh.worker.crash", shard=s)
         new_pid = h.respawn()
         _M_W_SPAWNS.inc()
         _M_W_RESPAWNS.inc()

@@ -15,13 +15,27 @@ Protocol (length-framed pickles over a ``multiprocessing`` pipe):
 
 - parent -> child: ``(op, payload)`` — deliveries fan out as pickled
   per-shard column batches (raw change bytes + local routing indices;
-  shards share NO mutable state, so nothing else needs to travel);
-- child -> parent: ``(status, payload, metrics_delta)`` — apply results
-  return as compact frames (double-pickled patch blob + flat outcome
-  tuples, see ``tpu.farm.result_to_wire``) so the controller defers
-  patch materialization until someone actually indexes the result;
-  every response piggybacks the worker registry's metric delta and, on
-  request, the worker's phase-profile dump for ``--watch`` attribution.
+  shards share NO mutable state, so nothing else needs to travel).
+  Apply payloads carry an ``obs`` leg: the controller's flight-enable
+  bit and the ambient ``DispatchSpan`` id, so worker-side latency
+  observations stamp the controller's trace ids (restored via
+  ``obs.scope.exemplar_context``);
+- child -> parent: ``(status, payload, metrics_delta, flight_events)``
+  — apply results return as compact frames (double-pickled patch blob +
+  flat outcome tuples, see ``tpu.farm.result_to_wire``) so the
+  controller defers patch materialization until someone actually
+  indexes the result; every response piggybacks the worker registry's
+  metric delta (exemplars included), the worker flight recorder's
+  unshipped tail (heartbeat pongs ship it too), and, on request, the
+  worker's phase-profile dump for ``--watch`` attribution.
+
+Crash forensics: when flight is enabled the worker maintains a bounded
+**black-box file** (``obs.flight.write_blackbox``: shard-tagged flight
+tail + the last delivery's phase profile), rewritten atomically after
+every telemetry-bearing response, registered for an atexit flush, and
+flushed again on the fault path — so a SIGKILL mid-delivery still
+leaves the previous deliveries' events on disk for ``_recover_worker``
+to absorb into the ``mesh.worker.crash`` dump.
 
 Workers are spawned with the **spawn** (not fork) start method: a forked
 JAX client shares page-table state with the parent and corrupts both;
@@ -85,17 +99,47 @@ def _worker_main(conn, spec: dict) -> None:
         del os.environ["XLA_FLAGS"]
     os.environ.update(stripped)
 
-    # each worker records into ITS OWN process-wide registry and ships
-    # deltas back with every response; the controller merges them.
+    # each worker records into ITS OWN process-wide registry and flight
+    # recorder and ships deltas/event tails back with every response; the
+    # controller merges them.
     # amlint: disable=AM502 — this IS the worker's own registry: the
     # process-global singleton of the *worker* process, never the
     # controller's (deltas ship via diff_frames/merge_frame)
     from ..obs.metrics import diff_frames, get_metrics
+    # amlint: disable=AM502,AM305 — the worker's own recorder IS the
+    # shipping buffer: events ship over the pipe / the black-box file,
+    # never through this process's exposition
+    from ..obs.flight import get_flight, write_blackbox
+    from ..obs.scope import exemplar_context
     from ..profiling import PhaseProfile, use_profile
     from ..tpu.farm import TpuDocFarm, exc_from_blob, exc_to_blob, result_to_wire
 
     metrics = get_metrics()  # amlint: disable=AM502 — same shipping buffer
     metrics.enable()
+    flight = get_flight()  # amlint: disable=AM502,AM305 — shipping buffer
+    flight.shard = spec["shard"]
+    flight.epoch = spec.get("epoch", 0)
+    blackbox_path = spec.get("blackbox_path")
+    m_blackbox = metrics.counter(
+        "mesh.telemetry.blackbox.writes",
+        "black-box files persisted by this worker",
+    )
+    last_phases = ""
+    blackbox_mark = flight._seq  # no events yet -> no file
+
+    def _flush_blackbox() -> None:
+        # bounded + atomic; skipped while nothing new happened so the
+        # obs-off path never touches the disk
+        nonlocal blackbox_mark
+        if blackbox_path is None or flight._seq == blackbox_mark:
+            return
+        blackbox_mark = flight._seq
+        write_blackbox(blackbox_path, flight, last_phases)
+        m_blackbox.inc()
+
+    import atexit
+
+    atexit.register(_flush_blackbox)
     farm_args = dict(
         capacity=spec["capacity"],
         quarantine_threshold=spec["quarantine_threshold"],
@@ -113,7 +157,7 @@ def _worker_main(conn, spec: dict) -> None:
         )
         del warm
     last_frame = metrics.frame()
-    conn.send(("ready", os.getpid(), None))
+    conn.send(("ready", os.getpid(), None, None))
 
     crash_armed = False
     while True:
@@ -122,42 +166,53 @@ def _worker_main(conn, spec: dict) -> None:
         except (EOFError, OSError):
             break
         if op == "shutdown":
-            conn.send(("ok", None, None))
+            conn.send(("ok", None, None, None))
             break
         if op == "_debug_die_now":
             # fire-and-forget test hook: die as if kill -9'd externally
             os.kill(os.getpid(), signal.SIGKILL)
         if op == "_debug_die_on_next_apply":
             crash_armed = True
-            conn.send(("ok", None, None))
+            conn.send(("ok", None, None, None))
             continue
         try:
             if op == "apply":
                 if crash_armed:
                     os.kill(os.getpid(), signal.SIGKILL)
-                resp = _do_apply(
-                    farm, payload, PhaseProfile, use_profile, result_to_wire,
-                    exc_to_blob,
-                )
+                # the obs leg toggles this worker's flight recorder to
+                # mirror the controller's and restores the controller's
+                # ambient dispatch-span id for exemplar stamping
+                obs = payload[3] if len(payload) > 3 else None
+                flight.enabled = bool(obs and obs.get("flight"))
+                with exemplar_context(obs.get("exemplar") if obs else None):
+                    resp = _do_apply(
+                        farm, payload, PhaseProfile, use_profile,
+                        result_to_wire, exc_to_blob,
+                    )
+                if isinstance(resp, dict) and resp.get("phases"):
+                    last_phases = resp["phases"]
             else:
                 resp = _dispatch(farm, op, payload, exc_to_blob, exc_from_blob)
             frame = metrics.frame()
             delta = diff_frames(frame, last_frame)
             last_frame = frame
+            events = flight.ship()
             try:
-                conn.send(("ok", resp, delta))
+                conn.send(("ok", resp, delta, events))
             except Exception as send_exc:  # unpicklable response payload
-                conn.send(("err", exc_to_blob(send_exc), delta))
+                conn.send(("err", exc_to_blob(send_exc), delta, events))
+            _flush_blackbox()
         except BaseException as exc:  # ship the failure; keep serving
+            _flush_blackbox()
             frame = metrics.frame()
             delta = diff_frames(frame, last_frame)
             last_frame = frame
-            conn.send(("err", exc_to_blob(exc), delta))
+            conn.send(("err", exc_to_blob(exc), delta, flight.ship()))
 
 
 def _do_apply(farm, payload, PhaseProfile, use_profile, result_to_wire,
               exc_to_blob) -> dict:
-    active, is_local, want_phases = payload
+    active, is_local, want_phases = payload[0], payload[1], payload[2]
     per_doc = [[] for _ in range(farm.num_docs)]
     for loc, bufs in active:
         per_doc[loc] = list(bufs)
@@ -275,20 +330,28 @@ class WorkerHandle:
     quarantine in-flight docs) belongs to the controller.
 
     ``on_delta`` receives each response's metric delta frame;
-    ``on_rpc`` fires once per request (both injected by meshfarm so this
-    module never touches the controller's process-global registries)."""
+    ``on_flight`` receives each response's shipped flight-event tail;
+    ``on_rpc`` fires once per request (all injected by meshfarm so this
+    module never touches the controller's process-global registries).
+
+    ``last_ok`` is the monotonic timestamp of the last successful
+    response (readiness counts) — ``heartbeat_age()`` is what the crash
+    event reports as "how long was this worker silent"."""
 
     def __init__(self, spec: dict, timeout: float | None = None,
-                 on_delta=None, on_rpc=None, defer_ready: bool = False):
+                 on_delta=None, on_rpc=None, on_flight=None,
+                 defer_ready: bool = False):
         self.spec = spec
         if timeout is None:
             timeout = float(os.environ.get("AM_MESH_WORKER_TIMEOUT_S", "600"))
         self.timeout = timeout
         self._on_delta = on_delta
         self._on_rpc = on_rpc
+        self._on_flight = on_flight
         self.conn = None
         self.proc = None
         self._ready = False
+        self.last_ok: float | None = None
         self._start()
         if not defer_ready:
             self.ensure_ready()
@@ -322,6 +385,7 @@ class WorkerHandle:
                 "instead of readiness"
             )
         self._ready = True
+        self.last_ok = time.monotonic()
         return msg[1]
 
     def spawn(self) -> int:
@@ -331,7 +395,17 @@ class WorkerHandle:
 
     def respawn(self) -> int:
         self._kill()
+        # a fresh epoch: the respawned worker's restarted flight seqs must
+        # not collide with its previous life's in the merged timeline
+        self.spec["epoch"] = self.spec.get("epoch", 0) + 1
         return self.spawn()
+
+    def heartbeat_age(self, now: float | None = None) -> float | None:
+        """Seconds since the last successful response, or None before
+        readiness ever completed."""
+        if self.last_ok is None:
+            return None
+        return (time.monotonic() if now is None else now) - self.last_ok
 
     def _kill(self) -> None:
         if self.proc is None:
@@ -415,11 +489,14 @@ class WorkerHandle:
             raise self._crash(f"pipe closed mid-send ({e!r})") from e
 
     def collect(self, timeout: float | None = None):
-        status, payload, delta = self._recv(
+        status, payload, delta, events = self._recv(
             self.timeout if timeout is None else timeout
         )
+        self.last_ok = time.monotonic()
         if delta and self._on_delta is not None:
             self._on_delta(delta)
+        if events and self._on_flight is not None:
+            self._on_flight(events)
         if status == "err":
             from ..tpu.farm import exc_from_blob
 

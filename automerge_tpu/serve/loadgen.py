@@ -44,6 +44,7 @@ from ..obs.export import SnapshotWriter, request_breakdown
 from ..obs.flight import get_flight
 from ..obs.metrics import enabled_metrics, get_metrics
 from ..obs.scope import get_amscope
+from ..obs.slo import SLOEngine, default_serve_slos, verdicts_ok
 from ..sync import decode_sync_message, encode_sync_message
 from ..sync_session import (
     BackendDriver,
@@ -71,6 +72,11 @@ _M_SHED_ADMISSION = _METRICS.counter(
 _M_REJECTED_DOWN = _METRICS.counter(
     "serve.loadgen.frames_rejected",
     "server frames a client session rejected (chaos corruption)",
+)
+_M_CONVERGED_RATIO = _METRICS.gauge(
+    "serve.clients.converged_ratio",
+    "converged fraction of the surviving fleet (the convergence SLO's "
+    "input gauge; surviving = doc neither poisoned nor quarantined)",
 )
 
 _SERVER = "server"
@@ -102,6 +108,13 @@ class LoadConfig:
     flight_dir: str | None = None       # auto-dump dir for "full" runs
     snapshot_path: str | None = None    # JSONL telemetry snapshots (--watch)
     snapshot_interval: float = 0.5      # simulated seconds between snapshots
+    # SLO knobs (active whenever the metrics registry is on). The latency
+    # budget is simulated ms against serve.sync.latency_ms and rounds DOWN
+    # to a log2 bucket bound (1000 -> 536.87ms effective): generous enough
+    # for the batching window + one dispatch, breached by retransmission
+    # storms.
+    slo_budget_ms: float = 1000.0
+    slo_latency_target: float = 0.99
 
 
 class _Client:
@@ -157,6 +170,7 @@ class LoadGen:
         self.shed_frames = 0
         self.rejected_down = 0
         self._snapshots = None  # SnapshotWriter, armed by run()
+        self._slo = None        # SLOEngine, armed by run()
 
     # -------------------------------------------------------------- #
     # fleet construction
@@ -364,7 +378,10 @@ class LoadGen:
         tenant table) and the flight recorder (auto-dumping to
         ``flight_dir`` on quarantine/watchdog events), "off" enables
         nothing — the disabled-hot-path shape the bench overhead gate
-        measures."""
+        measures. Whenever the registry is on, an ``SLOEngine`` over
+        ``default_serve_slos`` samples multi-window burn rates on the
+        simulated clock and the report carries its verdicts under
+        ``"slo"`` (``bench.py --serve`` gates on them)."""
         import contextlib
 
         cfg = self.config
@@ -397,23 +414,46 @@ class LoadGen:
             raise ValueError(  # amlint: disable=AM401 — API-usage validation
                 f"unknown observability mode: {cfg.observability!r}"
             )
+        self._slo = (
+            SLOEngine(
+                default_serve_slos(
+                    budget_ms=cfg.slo_budget_ms,
+                    latency_target=cfg.slo_latency_target,
+                    latency_metric="serve.sync.latency_ms",
+                ),
+                clock=self.clock,
+            )
+            if cfg.observability != "off" else None
+        )
         self._snapshots = (
             SnapshotWriter(cfg.snapshot_path, cfg.snapshot_interval,
-                           clock=self.clock)
+                           clock=self.clock, slo_engine=self._slo)
             if cfg.snapshot_path else None
         )
+        slo_verdicts = None
         with stack:
             converged = self._run_loop()
+            surviving = self._surviving()
+            unconverged = self._unconverged(surviving)
+            if self._slo is not None:
+                denom = len(surviving) or 1
+                _M_CONVERGED_RATIO.set(
+                    round((len(surviving) - len(unconverged)) / denom, 6)
+                )
+                slo_verdicts = self._slo.export()
             if self._snapshots is not None:
                 self._snapshots.write(self.clock())
         metrics = _METRICS.as_dict()
-        surviving = self._surviving()
-        unconverged = self._unconverged(surviving)
         occupancy = metrics.get("serve.batch.occupancy", {})
         dispatches = occupancy.get("count", 0)
         latency = metrics.get("serve.sync.latency_ms", {})
         committed = metrics.get("serve.batch.changes", {}).get("value", 0)
         extras = {}
+        if slo_verdicts is not None:
+            extras["slo"] = {
+                "verdicts": slo_verdicts,
+                "ok": verdicts_ok(slo_verdicts),
+            }
         if cfg.observability == "full":
             extras["breakdown"] = request_breakdown(metrics)
             extras["tenants"] = scope.tenant_stats()
@@ -461,6 +501,8 @@ class LoadGen:
         cfg = self.config
         idle_checks = 0
         while self.clock.now() < cfg.max_time:
+            if self._slo is not None:
+                self._slo.sample(self.clock())
             if self._snapshots is not None:
                 self._snapshots.maybe_write(self.clock())
             moved = self._issue_due_edits()

@@ -310,7 +310,8 @@ class AmServer:
     async def serve_forever(self, host: str = "127.0.0.1", port: int = 0,
                             *, telemetry_port: int | None = None,
                             snapshot_path: str | None = None,
-                            snapshot_interval: float = 5.0):
+                            snapshot_interval: float = 5.0,
+                            slo_engine=None):
         """Binds the core to asyncio streams: 4-byte big-endian length-
         prefixed frames, one connection per client. The first frame of a
         connection is a text hello ``b"HELLO <client_id> <doc> <tenant>"``;
@@ -323,14 +324,18 @@ class AmServer:
         exemplars) on a side-car HTTP listener that never enters the
         serving data path; ``snapshot_path`` appends a JSONL telemetry
         snapshot every ``snapshot_interval`` seconds from the flusher
-        task — the file ``python -m automerge_tpu.obs --watch`` renders."""
+        task — the file ``python -m automerge_tpu.obs --watch`` renders.
+        ``slo_engine`` (an ``obs.slo.SLOEngine``) is evaluated from the
+        flusher on this server's clock — the wall-clock leg of the SLO
+        plane: its ``slo.*`` gauges ride the exposition page and every
+        snapshot line carries the verdicts."""
         import asyncio
 
         from ..obs.export import SnapshotWriter, serve_exposition
 
         writer_snapshots = (
             SnapshotWriter(snapshot_path, snapshot_interval,
-                           clock=self.clock)
+                           clock=self.clock, slo_engine=slo_engine)
             if snapshot_path else None
         )
         telemetry = (
@@ -349,13 +354,23 @@ class AmServer:
             for writer in writers.values():
                 await writer.drain()
 
+        slo_last = None
+
         async def _flusher() -> None:
+            nonlocal slo_last
             while True:
                 await asyncio.sleep(self.batcher.config.flush_interval / 2)
                 self.tick()
                 await _send_all()
                 if writer_snapshots is not None:
                     writer_snapshots.maybe_write()
+                elif slo_engine is not None:
+                    # no snapshot file to drive the export — evaluate at
+                    # ~1Hz so the exposition page's slo.* gauges stay live
+                    now = self.clock()
+                    if slo_last is None or now - slo_last >= 1.0:
+                        slo_last = now
+                        slo_engine.export(now=now)
 
         async def _handle(reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:

@@ -543,7 +543,9 @@ def _serve_main(quick):
     trace (amscope), and bounded observability overhead — the same
     workload is run once on the PR 7 baseline stack (metrics only) and
     once with amscope+flight on, and the full stack's host time must stay
-    within BENCH_SERVE_OBS_OVERHEAD x the baseline's."""
+    within BENCH_SERVE_OBS_OVERHEAD x the baseline's. The serve SLO
+    verdicts (obs/slo.py burn-rate objectives over the simulated clock)
+    gate both modes: the report's ``slo.ok`` must hold."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     floor = float(os.environ.get("BENCH_SERVE_OCCUPANCY_FLOOR", "8"))
     overhead_cap = float(os.environ.get("BENCH_SERVE_OBS_OVERHEAD", "2.0"))
@@ -590,12 +592,14 @@ def _serve_main(quick):
         if poison == 0 else 0
     )
     breakdown = report.get("breakdown", {})
+    slo = report.get("slo", {})
     ok = (
         report["converged"]
         and report["occupancy_mean"] >= floor
         and unexplained_sheds == 0
         and breakdown.get("requests", 0) > 0
         and breakdown.get("p99_exemplar", {}).get("trace_id") is not None
+        and slo.get("ok", False)
         and (obs_overhead is None
              or obs_overhead["ratio"] <= overhead_cap)
     )
@@ -622,6 +626,7 @@ def _serve_main(quick):
         "frames_shed": report["frames_shed"],
         "breakdown": breakdown,
         "tenants": report.get("tenants", {}),
+        "slo": slo,
         "obs_overhead": obs_overhead,
     }))
     if quick:
@@ -629,7 +634,7 @@ def _serve_main(quick):
 
 
 def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
-               backend="inline"):
+               backend="inline", observability="metrics"):
     """`bench.py --mesh [--backend inline|process]`: the doc-sharded
     multi-chip merge farm (parallel/meshfarm.py) at full e2e fidelity —
     binary changes in, reference-format patches out, one shard-local
@@ -656,7 +661,16 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
     In --quick mode the gates are machine-independent: every shard
     dispatched, a forced mid-run migration preserving document state,
     actor-table reconcile converging (second pass syncs 0), a clean
-    ownership audit, and zero quarantines."""
+    ownership audit, and zero quarantines.
+
+    ``observability`` picks the stack for the measured loop: "metrics"
+    (the historical shape), "full" (metrics + flight recorder — in the
+    process backend the workers ship their shard-tagged flight tails
+    into the controller timeline, and the mesh SLO verdicts ride the
+    result), or "off" (nothing enabled — the baseline the quick-mode
+    obs-overhead gate measures against)."""
+    import contextlib
+
     import jax
 
     from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
@@ -715,10 +729,27 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
                         devices=devices)
     metrics = get_metrics()
     metrics.reset()
+    obs_stack = contextlib.ExitStack()
+    slo_engine = None
+    if observability in ("metrics", "full"):
+        obs_stack.enter_context(enabled_metrics())
+    if observability == "full":
+        from automerge_tpu.obs.flight import enabled_flight
+        from automerge_tpu.obs.slo import (
+            SLOEngine,
+            default_mesh_slos,
+            verdicts_ok,
+        )
+
+        obs_stack.enter_context(enabled_flight())
+        slo_engine = SLOEngine(default_mesh_slos())
+        slo_engine.sample()
+    elif observability not in ("metrics", "off"):
+        raise ValueError(f"unknown observability mode: {observability!r}")
     prof = PhaseProfile()
     migrated = None
     start = time.perf_counter()
-    with use_profile(prof), enabled_metrics():
+    with use_profile(prof), obs_stack:
         for r, buf in enumerate(buffers):
             mesh.apply_changes([[buf]] * num_docs)
             if quick and r == 0:
@@ -727,6 +758,8 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
                 dest = (mesh.shard_of(0) + 1) % num_shards
                 mesh.migrate_doc(0, dest)
                 migrated = {"doc": 0, "dest": dest}
+            if slo_engine is not None:
+                slo_engine.sample()
     elapsed = time.perf_counter() - start
     total_ops = num_docs * rounds * ops_per_round
 
@@ -781,8 +814,15 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
     worker_metrics = {
         name: entry.get("value", 0)
         for name, entry in snap.items()
-        if name.startswith("mesh.worker.")
+        if name.startswith(("mesh.worker.", "mesh.telemetry."))
     }
+    slo_block = None
+    if slo_engine is not None:
+        from automerge_tpu.obs.flight import get_flight
+
+        verdicts = slo_engine.evaluate()
+        slo_block = {"verdicts": verdicts, "ok": verdicts_ok(verdicts)}
+        flight_events = len(get_flight())
     mesh.close()
 
     try:
@@ -790,10 +830,16 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
     except AttributeError:  # non-Linux
         usable_cores = os.cpu_count() or 1
 
+    extras = {}
+    if slo_block is not None:
+        extras["slo"] = slo_block
+        extras["flight_events"] = flight_events
     return {
+        **extras,
         "backend": jax.default_backend(),
         "mesh_backend": backend,
         "usable_cores": usable_cores,
+        "observability": observability,
         "n_devices": num_shards,
         "num_shards": num_shards,
         "docs": num_docs,
@@ -841,7 +887,31 @@ def _mesh_child_main():
         num_docs = int(os.environ.get("BENCH_MESH_DOCS", "8192"))
         rounds = int(os.environ.get("BENCH_MESH_ROUNDS", "2"))
         ops = int(os.environ.get("BENCH_MESH_OPS", "256"))
-    result = bench_mesh(num_docs, rounds, ops, quick=quick, backend=backend)
+    obs_overhead = None
+    if quick:
+        # the measured-overhead gate, mirroring --serve: the identical
+        # seeded workload with observability off (the baseline; its first
+        # pass also eats the jit compiles for both), then with metrics +
+        # flight on — the full stack's measured loop must stay within
+        # BENCH_MESH_OBS_OVERHEAD x the baseline's. The gated result is
+        # the full-stack run, so the mesh SLO verdicts ride it.
+        overhead_cap = float(os.environ.get("BENCH_MESH_OBS_OVERHEAD", "2.0"))
+        baseline = bench_mesh(num_docs, rounds, ops, quick=quick,
+                              backend=backend, observability="off")
+        result = bench_mesh(num_docs, rounds, ops, quick=quick,
+                            backend=backend, observability="full")
+        obs_overhead = {
+            "baseline_elapsed_s": baseline["elapsed_s"],
+            "full_elapsed_s": result["elapsed_s"],
+            "ratio": round(
+                result["elapsed_s"] / baseline["elapsed_s"], 3
+            ) if baseline["elapsed_s"] else 1.0,
+            "cap": overhead_cap,
+        }
+        result["obs_overhead"] = obs_overhead
+    else:
+        result = bench_mesh(num_docs, rounds, ops, quick=quick,
+                            backend=backend)
     # machine-independent gates (both modes): real work, clean mesh
     ok = (
         result["all_shards_dispatched"]
@@ -852,7 +922,12 @@ def _mesh_child_main():
         and result["quarantined_docs"] == 0
     )
     if quick:
-        ok = ok and result["docs_migrated"] == 1
+        ok = (
+            ok
+            and result["docs_migrated"] == 1
+            and obs_overhead["ratio"] <= obs_overhead["cap"]
+            and result["slo"]["ok"]
+        )
     elif backend == "process":
         # the scaling gates are physical: N shard host phases can only
         # overlap on >= N usable cores, and per-shard PHASE wall-times on

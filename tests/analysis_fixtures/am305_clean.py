@@ -1,0 +1,11 @@
+# amlint: mesh-worker — fixture: shipped telemetry keeps worker code clean
+
+
+def serve_shard(conn, farm, recorder):
+    """The blessed worker shape: the flight recorder arrives injected;
+    its unshipped event tail rides the result frame and the controller
+    absorbs it into the unified timeline — no exposition access, no
+    process-global accessor."""
+    op, payload = conn.recv()
+    result = farm.apply_changes(payload)
+    conn.send(("ok", result, None, recorder.ship()))

@@ -1,0 +1,12 @@
+# amlint: mesh-worker — fixture: exposition-layer telemetry in a worker (AM305)
+from automerge_tpu.obs.export import render_exposition
+from automerge_tpu.obs.flight import get_flight
+
+
+def serve_shard(conn):
+    """The forbidden worker shape: records into the worker's own flight
+    recorder and then publishes the worker's own registry on an
+    exposition page the controller never scrapes — the numbers split-brain
+    instead of shipping over the pipe."""
+    get_flight().record("mesh.worker.spawns")
+    conn.send(("page", render_exposition(), None, None))

@@ -8,6 +8,15 @@ Covers the ISSUE 8 contract:
 - a chaos+poison loadgen run auto-dumps a timeline containing the
   quarantine events that occurred (the acceptance-criteria shape);
 - the ``--flight`` CLI renders a dump as a causally-ordered timeline.
+
+Plus the ISSUE 13 mesh telemetry channel:
+- ``ship()``/``absorb()`` move a worker recorder's unshipped tail into
+  the controller ring with origin tags and fresh controller seqs;
+- black-box recovery dedups against live-shipped events;
+- merged multi-process dumps order deterministically by
+  ``(seq, epoch, shard, wseq)`` while untagged single-process dumps keep
+  the exact pre-mesh shape (byte-identical timeline, no shard column);
+- the disabled path stays one counter compare — no ring access.
 """
 import json
 import os
@@ -16,11 +25,14 @@ import random
 import pytest
 
 from automerge_tpu.obs.flight import (
+    BLACKBOX_TAIL,
     FlightRecorder,
     enabled_flight,
     get_flight,
     load_jsonl,
+    read_blackbox,
     render_timeline,
+    write_blackbox,
 )
 from automerge_tpu.serve.loadgen import LoadConfig, LoadGen
 from automerge_tpu.testing.faults import bit_flipped
@@ -90,6 +102,148 @@ def test_trigger_without_dump_dir_still_records():
     rec.dump_dir = None
     assert rec.trigger("watchdog.reset") is None
     assert rec.snapshot()[-1]["event"] == "flight.trigger"
+
+
+# ---------------------------------------------------------------------- #
+# the mesh telemetry channel: ship -> absorb -> one merged timeline
+
+def test_ship_returns_unshipped_tail_exactly_once():
+    rec = FlightRecorder(clock=lambda: 0.0)
+    rec.enabled = True
+    rec.shard = 1
+    rec.record("a", x=1)
+    rec.record("b")
+    shipped = rec.ship()
+    assert [e["event"] for e in shipped] == ["a", "b"]
+    # shard-tagged: the worker's origin key rides every shipped event
+    assert all(e["shard"] == 1 and e["epoch"] == 0 for e in shipped)
+    assert shipped[0]["wseq"] == shipped[0]["seq"]
+    assert rec.ship() == []          # the mark advanced
+    rec.record("c")
+    assert [e["event"] for e in rec.ship()] == ["c"]
+
+
+def test_disabled_telemetry_channel_never_touches_the_ring():
+    """The S3 one-attribute assertions: while observability is off,
+    ``ship()`` is a counter compare and ``record``/``absorb`` return
+    before any ring access — a ring that explodes on use proves it."""
+    rec = FlightRecorder()
+    assert rec.enabled is False
+
+    class _Boom:
+        def __iter__(self):
+            raise AssertionError("disabled ship() walked the ring")
+
+        def append(self, item):
+            raise AssertionError("disabled path appended to the ring")
+
+    rec._ring = _Boom()
+    assert rec.ship() == []
+    rec.record("dropped", x=1)
+    assert rec.absorb([{"event": "x", "seq": 1}]) == 0
+
+
+def test_absorb_assigns_fresh_seqs_and_keeps_origin():
+    worker = FlightRecorder(clock=lambda: 5.0)
+    worker.enabled = True
+    worker.shard = 2
+    worker.epoch = 3
+    worker.record("w.event", n=1)
+    ctrl = FlightRecorder(clock=lambda: 9.0)
+    ctrl.enabled = True
+    ctrl.record("c.event")
+    assert ctrl.absorb(worker.ship()) == 1
+    events = ctrl.snapshot()
+    assert [e["event"] for e in events] == ["c.event", "w.event"]
+    absorbed = events[-1]
+    assert absorbed["seq"] == 2              # fresh controller seq
+    assert (absorbed["shard"], absorbed["epoch"], absorbed["wseq"]) \
+        == (2, 3, 1)
+    assert absorbed["t"] == 5.0              # the worker's own clock
+    assert absorbed["fields"] == {"n": 1}
+
+
+def test_absorb_dedup_skips_live_shipped_origins():
+    """Black-box recovery: the dead worker's tail overlaps what it
+    already shipped live — dedup absorbs only the genuinely new events,
+    keyed by origin, and the merged timeline stays duplicate-free."""
+    worker = FlightRecorder(clock=lambda: 1.0)
+    worker.enabled = True
+    worker.shard = 1
+    ctrl = FlightRecorder(clock=lambda: 2.0)
+    ctrl.enabled = True
+    worker.record("a")
+    worker.record("b")
+    ctrl.absorb(worker.ship())               # live ship before the crash
+    worker.record("c")                       # died before shipping this
+    tail = worker.tail(BLACKBOX_TAIL)        # the black-box shape: a,b,c
+    assert ctrl.absorb(tail, dedup=True) == 1
+    mesh_events = [e for e in ctrl.snapshot() if e.get("shard") == 1]
+    assert [e["event"] for e in mesh_events] == ["a", "b", "c"]
+
+
+def test_merge_key_orders_colliding_dumps_deterministically():
+    """The S1 ordering fix: per-process seqs collide when a controller
+    dump and a dead worker's black box are concatenated; the merge key
+    ``(seq, epoch, shard, wseq)`` interleaves them deterministically
+    (controller rows first, then shards, then respawn epochs)."""
+    rows = [
+        {"seq": 1, "t": 0.0, "event": "w1", "fields": {},
+         "shard": 1, "epoch": 0, "wseq": 1},
+        {"seq": 1, "t": 0.0, "event": "c", "fields": {}},
+        {"seq": 1, "t": 0.0, "event": "w0e1", "fields": {},
+         "shard": 0, "epoch": 1, "wseq": 1},
+        {"seq": 1, "t": 0.0, "event": "w0", "fields": {},
+         "shard": 0, "epoch": 0, "wseq": 1},
+        {"seq": 2, "t": 0.0, "event": "w0b", "fields": {},
+         "shard": 0, "epoch": 0, "wseq": 2},
+    ]
+    merged = load_jsonl("\n".join(json.dumps(r) for r in rows))
+    assert [e["event"] for e in merged] == ["c", "w0", "w1", "w0e1", "w0b"]
+
+
+def test_untagged_dump_keeps_the_pre_mesh_shape():
+    """Single-process runs are byte-identical to the pre-mesh format: no
+    origin keys in the events, no shard column in the timeline."""
+    rec = FlightRecorder(clock=lambda: 1.0)
+    rec.enabled = True
+    rec.record("a", k=1)
+    events = load_jsonl(rec.to_jsonl())
+    assert set(events[0]) == {"seq", "t", "event", "fields"}
+    table = render_timeline(events)
+    assert "shard" not in table.splitlines()[0]
+
+
+def test_timeline_grows_shard_column_only_when_tagged():
+    untagged = [{"seq": 1, "t": 0.0, "event": "local.ev", "fields": {}}]
+    tagged = untagged + [{"seq": 2, "t": 0.0, "event": "worker.ev",
+                          "fields": {}, "shard": 3, "epoch": 0, "wseq": 1}]
+    table = render_timeline(tagged)
+    header, row_local, row_worker = table.splitlines()
+    assert "shard" in header
+    assert "-" in row_local.split("local.ev")[0]    # controller rows: '-'
+    assert "3" in row_worker.split("worker.ev")[0]  # worker rows: shard id
+
+
+def test_blackbox_write_read_round_trip(tmp_path):
+    rec = FlightRecorder(clock=lambda: 2.0)
+    rec.enabled = True
+    rec.shard = 1
+    rec.epoch = 2
+    for i in range(BLACKBOX_TAIL + 10):
+        rec.record("e", i=i)
+    path = str(tmp_path / "bb.json")
+    write_blackbox(path, rec, phases_jsonl="{}")
+    bb = read_blackbox(path)
+    assert bb["pid"] == os.getpid()
+    assert (bb["shard"], bb["epoch"]) == (1, 2)
+    assert len(bb["events"]) == BLACKBOX_TAIL     # bounded tail
+    assert bb["events"][-1]["fields"]["i"] == BLACKBOX_TAIL + 9
+    assert bb["phases"] == "{}"
+    # best-effort by contract: absent and torn files read as None
+    assert read_blackbox(str(tmp_path / "missing.json")) is None
+    (tmp_path / "torn.json").write_text("{not json", encoding="utf-8")
+    assert read_blackbox(str(tmp_path / "torn.json")) is None
 
 
 # ---------------------------------------------------------------------- #

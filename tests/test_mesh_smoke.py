@@ -86,6 +86,28 @@ def test_reconcile_converges():
     assert result["reconcile"]["second_sync"] == 0
 
 
+def test_quick_gate_pins_obs_overhead_and_slo_verdicts():
+    """The ISSUE 13 machine-independent gates: the quick run measures an
+    off-baseline vs full-stack (metrics+flight+SLO) pass whose overhead
+    ratio must stay under the cap, and the mesh SLO verdicts must all be
+    in compliance — both already folded into ``result["ok"]``, pinned
+    here so a silent gate removal fails tier-1."""
+    result = _smoke()
+    assert result["observability"] == "full"
+    overhead = result["obs_overhead"]
+    assert overhead["cap"] == 2.0
+    assert overhead["baseline_elapsed_s"] > 0
+    assert overhead["full_elapsed_s"] > 0
+    assert 0 < overhead["ratio"] <= overhead["cap"]
+    slo = result["slo"]
+    assert slo["ok"] is True
+    verdicts = {v["objective"]: v for v in slo["verdicts"]}
+    assert set(verdicts) == {"mesh_delivery", "mesh_workers"}
+    assert all(v["ok"] for v in verdicts.values())
+    assert verdicts["mesh_delivery"]["kind"] == "availability"
+    assert result["flight_events"] > 0
+
+
 # --------------------------------------------------------------------- #
 # make_mesh / MeshFarm argument validation (satellite: `sp` used to be
 # silently ignored when it did not divide the device count)

@@ -12,6 +12,7 @@ the controller's delivery log).
 """
 import json
 import multiprocessing
+import os
 import time
 
 import pytest
@@ -192,6 +193,102 @@ def test_quarantine_reads_are_rpc_free_on_process_backend():
         assert calls == []
     finally:
         mesh.close()
+    assert multiprocessing.active_children() == []
+
+
+def test_worker_crash_flight_dump_contains_blackbox_forensics(tmp_path):
+    """The ISSUE 13 acceptance shape: SIGKILL a worker mid-delivery with
+    the flight plane on — the controller's ``mesh.worker.crash`` auto-dump
+    must contain the dead worker's shard-tagged pre-crash events (live
+    shipped over the pipe, topped up from its black-box file) alongside
+    the crash entry with its forensic fields."""
+    from automerge_tpu.obs.flight import enabled_flight, load_jsonl
+
+    deliveries = _rounds(rounds=2)
+    with enabled_flight(dump_dir=str(tmp_path)) as rec:
+        rec.clear()
+        mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                        mesh_backend="process")
+        try:
+            # round 0 runs clean: the workers compile, record shard-tagged
+            # flight events and ship them live with the result frame
+            mesh.apply_changes(
+                [list(deliveries[0]) for _ in range(NUM_DOCS)],
+                isolation="doc",
+            )
+            assert any(e.get("shard") == 1 for e in rec.snapshot()), \
+                "round 0 shipped no shard-1 worker events"
+            # the worker flushes its black box AFTER sending the result
+            # frame; a heartbeat round trip sequences behind that flush
+            # (the worker is single-threaded)
+            assert mesh.heartbeat() == {0: "ok", 1: "ok"}
+            bb_path = mesh._handles[1].spec["blackbox_path"]
+            assert os.path.exists(bb_path), "worker wrote no black box"
+            mesh.inject_worker_fault(1, when="next_apply")
+            res = mesh.apply_changes(
+                [list(deliveries[1]) for _ in range(NUM_DOCS)],
+                isolation="doc",
+            )
+            assert res.quarantined
+        finally:
+            mesh.close()
+    assert multiprocessing.active_children() == []
+    assert rec.dump_paths, "the crash did not auto-dump the timeline"
+    events = load_jsonl(open(rec.dump_paths[-1], encoding="utf-8").read())
+    crashes = [e for e in events if e["event"] == "mesh.worker.crash"]
+    assert crashes, [e["event"] for e in events]
+    fields = crashes[-1]["fields"]
+    assert fields["shard"] == 1
+    assert isinstance(fields["pid"], int) and fields["pid"] > 0
+    assert fields["phase"] == "apply"
+    assert "heartbeat_age_s" in fields
+    assert fields["blackbox"] == bb_path      # S2: recovered file path
+    assert fields["blackbox_events"] >= 0
+    # the dead worker's own events sit in the same dump, shard-tagged and
+    # ordered before the crash entry
+    worker_events = [e for e in events
+                     if e.get("shard") == 1
+                     and e["event"] != "mesh.worker.crash"]
+    assert worker_events, "no shard-1 pre-crash events in the crash dump"
+    crash_idx = events.index(crashes[-1])
+    assert events.index(worker_events[0]) < crash_idx
+    # the inline backend, fed the same rounds, produces an untagged
+    # single-process dump: byte-identical to the pre-mesh shape
+    with enabled_flight() as rec2:
+        rec2.clear()
+        _drive_inline(deliveries)
+        assert all("shard" not in e for e in rec2.snapshot())
+    assert multiprocessing.active_children() == []
+
+
+def test_worker_exemplar_resolves_to_controller_span():
+    """The ISSUE 13 trace-propagation acceptance: a latency exemplar
+    recorded inside a process-mode worker (``farm.dispatch.latency_ms``)
+    resolves to the controller-side dispatch span id in ONE lookup — the
+    span id travels in the fan-out payload, the worker stamps it, and the
+    shipped metric delta carries it back."""
+    from automerge_tpu.obs.metrics import enabled_metrics
+    from automerge_tpu.obs.scope import dispatch_context, get_amscope
+
+    deliveries = _rounds(rounds=1)
+    with enabled_metrics() as reg:
+        reg.reset()
+        mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                        mesh_backend="process")
+        try:
+            span = get_amscope().begin_dispatch([], 0.0)
+            with dispatch_context(span):
+                mesh.apply_changes(
+                    [list(deliveries[0]) for _ in range(NUM_DOCS)],
+                    isolation="doc",
+                )
+            hist = reg.find("farm.dispatch.latency_ms")
+            assert hist is not None and hist.count > 0, \
+                "no worker-side dispatch observations merged back"
+            # one lookup: the p99 bucket's exemplar IS the controller span
+            assert hist.exemplar_for(0.99) == span.dispatch_id
+        finally:
+            mesh.close()
     assert multiprocessing.active_children() == []
 
 

@@ -83,10 +83,11 @@ def snapshot_file(tmp_path_factory):
     ))
     report = gen.run()
     assert report["converged"]
-    return path
+    return {"path": path, "report": report}
 
 
 def test_watch_renders_latest_snapshot_headlessly(snapshot_file, capsys):
+    snapshot_file = snapshot_file["path"]
     """The --watch satellite, exercised headlessly: one frame with the
     tenant table, the phase shares and the flight tail, exit 0."""
     from automerge_tpu.obs.__main__ import main
@@ -98,6 +99,31 @@ def test_watch_renders_latest_snapshot_headlessly(snapshot_file, capsys):
     assert "tenants" in out
     assert "t0" in out  # a tenant row
     assert "flight tail" in out
+
+
+def test_loadgen_report_and_snapshots_carry_slo_verdicts(snapshot_file,
+                                                         capsys):
+    """ISSUE 13: an observability!="off" load-harness run evaluates the
+    serve SLO set on the simulated clock — the report carries the verdict
+    block, every snapshot line embeds the verdicts as of its tick, and
+    the --watch view renders the SLO panel."""
+    report = snapshot_file["report"]
+    assert report["slo"]["ok"] is True
+    names = {v["objective"] for v in report["slo"]["verdicts"]}
+    assert names == {
+        "serve_latency", "serve_availability", "serve_convergence",
+    }
+    lines = [
+        json.loads(ln)
+        for ln in snapshot_file["path"].read_text().splitlines()
+    ]
+    assert lines and all("slo" in rec for rec in lines)
+    from automerge_tpu.obs.__main__ import main
+
+    assert main(["--watch", str(snapshot_file["path"])]) == 0
+    out = capsys.readouterr().out
+    assert "-- SLOs --" in out
+    assert "serve_latency" in out and "serve_convergence" in out
 
 
 def test_watch_renders_mesh_shard_table(tmp_path, capsys):
@@ -136,7 +162,8 @@ def test_watch_renders_mesh_shard_table(tmp_path, capsys):
 def test_watch_snapshot_lines_are_self_contained(snapshot_file):
     lines = [
         json.loads(line)
-        for line in snapshot_file.read_text(encoding="utf-8").splitlines()
+        for line in snapshot_file["path"].read_text(
+            encoding="utf-8").splitlines()
         if line.strip()
     ]
     assert len(lines) >= 2  # periodic + final

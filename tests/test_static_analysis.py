@@ -44,15 +44,16 @@ def test_repo_suppressions_are_justified():
     AM105 site, the scalar-oracle byte loops AM106 marks in codecs.py,
     the scalar-oracle gate/transcode loops AM107 marks in farm.py,
     the single real-time clock default AM402 site, and the mesh
-    worker's record-locally/ship-deltas registry sites AM502 marks in
-    parallel/workers.py), proving the suppression path is exercised
-    in-tree, and each sits on a line whose surrounding comment carries
-    a justification."""
+    worker's record-locally/ship-deltas registry and flight shipping-
+    buffer sites AM502/AM305 mark in parallel/workers.py), proving the
+    suppression path is exercised in-tree, and each sits on a line whose
+    surrounding comment carries a justification."""
     everything = run_analysis([PACKAGE], include_suppressed=True)
     suppressed = [f for f in everything if f.suppressed]
     assert suppressed, "expected in-tree justified suppressions"
     assert {f.rule_id for f in suppressed} == {
-        "AM103", "AM105", "AM106", "AM107", "AM401", "AM402", "AM502",
+        "AM103", "AM105", "AM106", "AM107", "AM305", "AM401", "AM402",
+        "AM502",
     }
 
 
