@@ -34,7 +34,11 @@ Rule families (see core.RULES for the catalog):
   in either direction (AM304); worker-executed modules reaching the
   telemetry exposition/fan-in layer (``get_flight``, ``obs.export``) —
   worker telemetry leaves the process only through the shipping buffer:
-  pipe deltas, shipped flight tails and the black-box file (AM305).
+  pipe deltas, shipped flight tails and the black-box file (AM305);
+  bare ``jax.jit`` references bypassing the amprof observatory —
+  compiled programs register through ``tpu/jitprof.profiled_jit`` so
+  recompiles carry program identity, with justified
+  ``# amlint: unprofiled-jit`` escapes (AM306).
 - **AM4xx taxonomy/serve**: data-plane modules raising bare ValueError/
   TypeError instead of classifiable taxonomy errors (AM401); sync
   data-plane modules calling wall clocks or the global RNG directly
@@ -60,7 +64,7 @@ import tokenize
 from pathlib import Path
 
 from . import (boundary, catalog, hotpath, meshrules, obsrules, packing,
-               taxonomy, tracer, workerrules)
+               profrules, taxonomy, tracer, workerrules)
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -93,7 +97,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
     for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
-                   hotpath, meshrules, workerrules):
+                   hotpath, meshrules, workerrules, profrules):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

@@ -74,7 +74,12 @@ RULES: dict[str, tuple[str, str]] = {
                           "telemetry leaves the process only through the "
                           "shipping buffer: pipe deltas, shipped flight "
                           "tails and the black-box file"),
-    "AM401": ("taxonomy", "bare ValueError/TypeError raised in a data-plane "
+    "AM306": ("boundary", "bare jax.jit call site (compiled programs must "
+                          "register through the amprof observatory via "
+                          "tpu/jitprof.profiled_jit so recompiles carry "
+                          "program identity; justify exceptions with "
+                          "`# amlint: unprofiled-jit`)"),
+    "AM401": ("taxonomy","bare ValueError/TypeError raised in a data-plane "
                           "module (raise a classifiable taxonomy error from "
                           "automerge_tpu.errors)"),
     "AM402": ("taxonomy", "direct wall-clock/sleep/global-RNG call "
@@ -100,6 +105,9 @@ _SUPPRESS_RE = re.compile(
 )
 _HOST_ONLY_RE = re.compile(r"#\s*amlint:\s*host-only")
 _HOT_PATH_RE = re.compile(r"#\s*amlint:\s*hot-path")
+#: justified observatory bypass: suppresses AM306 on its line (trailing)
+#: or the next code line (standalone), like a disable=AM306
+_UNPROFILED_JIT_RE = re.compile(r"#\s*amlint:\s*unprofiled-jit\b")
 
 
 @dataclasses.dataclass
@@ -165,10 +173,17 @@ class FileContext:
             if _HOT_PATH_RE.search(text):
                 self.hot_path_marker = True
             m = _SUPPRESS_RE.search(text)
-            if not m:
+            ids: set[str] = set()
+            kind = None
+            if m:
+                ids = {p.strip() for p in m.group(2).split(",") if p.strip()}
+                kind = m.group(1)
+            if _UNPROFILED_JIT_RE.search(text):
+                ids.add("AM306")
+                kind = kind or "disable"
+            if not ids:
                 continue
-            ids = {p.strip() for p in m.group(2).split(",") if p.strip()}
-            if m.group(1) == "disable-file":
+            if kind == "disable-file":
                 self.file_suppress |= ids
             elif standalone:
                 target = next((c for c in sorted_code if c > line), None)

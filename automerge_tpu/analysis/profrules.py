@@ -1,0 +1,63 @@
+"""AM306 — compiled programs register through the amprof observatory.
+
+The observatory (obs/prof.py) can only attribute recompiles, dispatch
+latencies and shape buckets to a program if the program was jitted
+through ``tpu/jitprof.profiled_jit``. A bare ``jax.jit`` reference —
+``@jax.jit``, ``@partial(jax.jit, ...)`` or a direct ``jax.jit(fn)``
+call — creates an anonymous compiled program the profiling plane cannot
+see, and its recompiles surface as unattributed ``engine.jit.recompiles``
+with no flight identity.
+
+Exempt references:
+
+- a ``jax.jit`` call fed directly to an ``Observatory.register(...)``
+  call, or any reference inside a function named ``profiled_jit`` — that
+  IS the blessed registration site (tpu/jitprof.py);
+- lines carrying a justified ``# amlint: unprofiled-jit`` marker (core.py
+  treats the marker as a line suppression for this rule, same
+  trailing/standalone placement as ``disable=``).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Finding, dotted_name
+
+#: leaf names of calls whose arguments are registration-bound jits
+_REGISTER_LEAVES = frozenset({"register"})
+
+#: enclosing function names that ARE the blessed jit wrapper
+_WRAPPER_FUNCS = frozenset({"profiled_jit"})
+
+
+def _exempt(node: ast.AST) -> bool:
+    cur = getattr(node, "_amlint_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            name = dotted_name(cur.func)
+            if name is not None and name.split(".")[-1] in _REGISTER_LEAVES:
+                return True
+        if (isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and cur.name in _WRAPPER_FUNCS):
+            return True
+        cur = getattr(cur, "_amlint_parent", None)
+    return False
+
+
+def check(ctxs: list[FileContext]) -> list[Finding]:
+    findings: list[Finding] = []
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if dotted_name(node) != "jax.jit":
+                continue
+            if _exempt(node):
+                continue
+            findings.append(ctx.finding(
+                "AM306", node,
+                "bare jax.jit reference bypasses the amprof observatory — "
+                "register the program with tpu/jitprof.profiled_jit "
+                "(or justify with `# amlint: unprofiled-jit`)",
+            ))
+    return findings

@@ -43,7 +43,7 @@ import ast
 
 from .core import FileContext, Finding, dotted_name
 
-_JIT_DECORATORS = {"jit", "vmap", "pmap"}
+_JIT_DECORATORS = {"jit", "vmap", "pmap", "profiled_jit"}
 _COMBINATORS = {
     "jit", "vmap", "pmap", "scan", "fori_loop", "while_loop", "cond",
     "switch", "pallas_call", "reduce", "associative_scan", "remat",

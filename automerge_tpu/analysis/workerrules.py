@@ -69,6 +69,7 @@ CONTROLLER_SEGMENTS = frozenset({"meshfarm", "serve"})
 #: process-global registry accessors (obs + profiling singletons)
 GLOBAL_ACCESSORS = frozenset({
     "get_metrics", "get_flight", "get_amscope", "get_trace", "get_profile",
+    "get_observatory",
 })
 
 #: exposition/fan-in layer names a worker must never touch (AM305):

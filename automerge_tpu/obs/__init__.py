@@ -30,6 +30,13 @@ Five parts plus a CLI:
 - **Live telemetry** (`obs.export`): Prometheus-style text exposition
   (mounted on the asyncio adapter's telemetry port), periodic JSONL
   snapshots, and the per-request phase-share math.
+- **amprof** (`obs.prof`, `obs.ledger`): the compiled-program
+  observatory — every tpu-layer jit program registers a named
+  ``ProfiledProgram`` wrapper recording per-program compile/dispatch
+  tallies, latency histograms and shape buckets, with a recompile-storm
+  detector — plus the memory ``Sampler`` (slab pages, DecodeCache and
+  change-column bytes as ``prof.mem.*`` gauges) and the append-only
+  perf ledger bench runs write their normalized records to.
 - **SLOs** (`obs.slo`): declared objectives (latency percentile under
   budget, availability, convergence ratio) evaluated as multi-window
   burn rates on an injected clock — simulated and wall clocks both
@@ -59,6 +66,13 @@ from .metrics import (
     enabled_metrics,
     get_metrics,
 )
+from .prof import (
+    Observatory,
+    ProfiledProgram,
+    Sampler,
+    enabled_observatory,
+    get_observatory,
+)
 from .scope import (
     Amscope,
     DispatchSpan,
@@ -85,8 +99,11 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "Objective",
+    "Observatory",
+    "ProfiledProgram",
     "RequestScope",
     "SLOEngine",
+    "Sampler",
     "SpanNode",
     "Trace",
     "availability_objective",
@@ -94,9 +111,11 @@ __all__ = [
     "enabled_flight",
     "enabled_metrics",
     "enabled_observability",
+    "enabled_observatory",
     "get_amscope",
     "get_flight",
     "get_metrics",
+    "get_observatory",
     "get_trace",
     "latency_objective",
     "ratio_objective",
@@ -108,10 +127,10 @@ __all__ = [
 @contextlib.contextmanager
 def enabled_observability(flight_dir: str | None = None):
     """Enables the whole observability stack — metrics registry, amscope
-    request tracing and the flight recorder — for the dynamic extent,
-    restoring every previous enabled state on exit. The one-call opt-in
-    the load harness and bench workloads use."""
+    request tracing, the flight recorder and the amprof observatory —
+    for the dynamic extent, restoring every previous enabled state on
+    exit. The one-call opt-in the load harness and bench workloads use."""
     with enabled_metrics(), enabled_amscope(), enabled_flight(
         dump_dir=flight_dir
-    ):
+    ), enabled_observatory():
         yield

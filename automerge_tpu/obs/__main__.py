@@ -15,6 +15,8 @@ and renders it without running anything.
     python -m automerge_tpu.obs --flight dump.jsonl  # flight timeline
     python -m automerge_tpu.obs --watch snaps.jsonl  # live telemetry view
     python -m automerge_tpu.obs --watch snaps.jsonl --follow
+    python -m automerge_tpu.obs --ledger ledger.jsonl           # trajectory
+    python -m automerge_tpu.obs --ledger ledger.jsonl --diff -2 -1
 
 ``--flight`` renders a flight-recorder dump (obs/flight.py) as a
 causally-ordered timeline. ``--watch`` renders the newest line of a
@@ -35,7 +37,7 @@ import os
 import random
 import sys
 
-from .export import request_breakdown, shard_table
+from .export import program_table, request_breakdown, shard_table
 from .flight import load_jsonl, render_timeline
 from .metrics import enabled_metrics, get_metrics
 from .spans import Trace, use_trace
@@ -199,6 +201,21 @@ def _render_watch_frame(record: dict) -> str:
                 else:
                     cells.append("-" if v is None else str(v))
             lines.append("  ".join([f"{shard:>5}"] + [f"{c:>18}" for c in cells]))
+    programs = program_table(record.get("metrics", {}))
+    if programs:
+        lines.append("")
+        lines.append("-- programs (amprof) --")
+        lines.append(
+            f"{'program':<28} {'compiles':>9} {'dispatches':>11} "
+            f"{'compile_ms':>11} {'dispatch_ms':>12}"
+        )
+        for name, row in programs.items():
+            lines.append(
+                f"{name:<28} {row.get('compiles', 0):>9} "
+                f"{row.get('dispatches', 0):>11} "
+                f"{row.get('compile_ms', 0.0):>11} "
+                f"{row.get('dispatch_ms', 0.0):>12}"
+            )
     slo = record.get("slo")
     if slo:
         from .slo import render_verdicts
@@ -268,6 +285,13 @@ def main(argv=None) -> int:
                         help="render the newest telemetry snapshot in FILE "
                              "(tenant table + phase shares + flight tail); "
                              "headless one-frame render unless --follow")
+    parser.add_argument("--ledger", metavar="FILE",
+                        help="render the perf-ledger trajectory in FILE "
+                             "(bench-appended JSONL, obs/ledger.py); "
+                             "combine with --diff to compare two records")
+    parser.add_argument("--diff", nargs=2, type=int, metavar=("A", "B"),
+                        help="with --ledger: diff records A and B by index "
+                             "(negative indices count from the end)")
     parser.add_argument("--follow", action="store_true",
                         help="with --watch: keep refreshing top-style")
     parser.add_argument("--interval", type=float, default=1.0,
@@ -277,6 +301,31 @@ def main(argv=None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="print one JSON object instead of tables")
     args = parser.parse_args(argv)
+
+    if args.ledger:
+        from .ledger import (diff_records, load_ledger, render_diff,
+                             render_trajectory)
+
+        records = load_ledger(args.ledger)
+        if args.diff:
+            a_i, b_i = args.diff
+            try:
+                a, b = records[a_i], records[b_i]
+            except IndexError:
+                print(
+                    f"--ledger: diff indices {a_i},{b_i} out of range "
+                    f"({len(records)} record(s))", file=sys.stderr,
+                )
+                return 1
+            if args.json:
+                print(json.dumps(diff_records(a, b), sort_keys=True))
+            else:
+                print(render_diff(a, b))
+        elif args.json:
+            print(json.dumps(records, sort_keys=True))
+        else:
+            print(render_trajectory(records))
+        return 0
 
     if args.flight:
         with open(args.flight, "r", encoding="utf-8") as fh:

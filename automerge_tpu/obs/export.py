@@ -185,16 +185,19 @@ def _p99_exemplar(snap: dict):
 
 def shard_table(metrics_snapshot: dict) -> dict:
     """Per-shard rollup of the mesh's shard-labelled instrument families
-    (``mesh.shard.<s>.*`` and ``serve.flush.shard.<s>.docs``) from a
-    ``registry.as_dict()`` snapshot: ``{shard: {suffix: value}}``, shards
-    in ascending order. Histograms collapse to their count/sum/p99 (the
-    figures the mesh bench reports per shard); counters and gauges pass
-    their value through. The serving-side family keeps a ``flush.``
-    prefix so ``serve.flush.shard.<s>.docs`` never shadows the mesh's
-    ``mesh.shard.<s>.docs`` in the same row."""
+    (``mesh.shard.<s>.*``, ``mesh.pipe.<s>.*`` and
+    ``serve.flush.shard.<s>.docs``) from a ``registry.as_dict()``
+    snapshot: ``{shard: {suffix: value}}``, shards in ascending order.
+    Histograms collapse to their count/sum/p99 (the figures the mesh
+    bench reports per shard); counters and gauges pass their value
+    through. The serving-side family keeps a ``flush.`` prefix so
+    ``serve.flush.shard.<s>.docs`` never shadows the mesh's
+    ``mesh.shard.<s>.docs``, and the transport family keeps a ``pipe.``
+    prefix for the same reason (``mesh.pipe.<s>.bytes_out`` lands as
+    ``pipe.bytes_out``)."""
     import re
 
-    pattern = re.compile(r"^(mesh|serve\.flush)\.shard\.(\d+)\.(.+)$")
+    pattern = re.compile(r"^(mesh|serve\.flush)\.(shard|pipe)\.(\d+)\.(.+)$")
     table: dict[int, dict] = {}
     for name, snap in metrics_snapshot.items():
         m = pattern.match(name)
@@ -208,11 +211,37 @@ def shard_table(metrics_snapshot: dict) -> dict:
             }
         else:
             cell = snap.get("value")
-        suffix = m.group(3)
+        suffix = m.group(4)
         if m.group(1) == "serve.flush":
             suffix = f"flush.{suffix}"
-        table.setdefault(int(m.group(2)), {})[suffix] = cell
+        elif m.group(2) == "pipe":
+            suffix = f"pipe.{suffix}"
+        table.setdefault(int(m.group(3)), {})[suffix] = cell
     return {s: table[s] for s in sorted(table)}
+
+
+def program_table(metrics_snapshot: dict) -> dict:
+    """Per-program rollup of the amprof observatory's instrument family
+    (``prof.program.<name>.{compiles,dispatches,compile_ms,dispatch_ms}``)
+    from a ``registry.as_dict()`` snapshot: ``{program: {suffix: value}}``,
+    programs in name order. Histogram suffixes collapse to their sum (the
+    total wall ms the ``--watch`` programs panel shows)."""
+    import re
+
+    pattern = re.compile(
+        r"^prof\.program\.(.+)\.(compiles|dispatches|compile_ms|dispatch_ms)$"
+    )
+    table: dict[str, dict] = {}
+    for name, snap in metrics_snapshot.items():
+        m = pattern.match(name)
+        if m is None:
+            continue
+        if snap.get("type") == "histogram":
+            cell = round(snap.get("sum", 0.0), 3)
+        else:
+            cell = snap.get("value")
+        table.setdefault(m.group(1), {})[m.group(2)] = cell
+    return {p: table[p] for p in sorted(table)}
 
 
 def snapshot_record(t: float | None = None, registry=None, scope=None,

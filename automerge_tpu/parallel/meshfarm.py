@@ -77,6 +77,7 @@ import numpy as np
 from ..errors import PackingLimitError, WorkerCrashError, error_kind
 from ..obs.flight import get_flight, read_blackbox
 from ..obs.metrics import get_metrics
+from ..obs.prof import get_observatory
 from ..obs.scope import current_exemplar
 from ..profiling import get_profile
 from ..tpu.farm import (
@@ -140,6 +141,7 @@ _M_TELEMETRY_RECOVERED = _METRICS.counter(
     "dead-worker black-box files recovered into crash dumps",
 )
 _FLIGHT = get_flight()
+_OBSERVATORY = get_observatory()
 
 
 #: monotonic suffix for black-box paths (parallel meshes in one process)
@@ -181,6 +183,66 @@ def _shard_docs(s: int):
         )
         _SHARD_DOCS[s] = c
     return c
+
+
+# the mesh pickle tax, measured (ROADMAP item 2b): every frame the
+# controller moves over a shard's pipe records its pickled size and
+# serialize/deserialize wall time under mesh.pipe.<s>.* — the family the
+# shared-memory transport PR will be judged against
+_PIPE_INSTRUMENTS: dict[int, tuple] = {}
+
+
+def _pipe_instruments(s: int) -> tuple:
+    m = _PIPE_INSTRUMENTS.get(s)
+    if m is None:
+        m = (
+            _METRICS.counter(
+                f"mesh.pipe.{s}.bytes_out",
+                f"pickled bytes sent to shard {s}'s worker",
+            ),
+            _METRICS.counter(
+                f"mesh.pipe.{s}.bytes_in",
+                f"pickled bytes received from shard {s}'s worker",
+            ),
+            _METRICS.counter(
+                f"mesh.pipe.{s}.frames_out",
+                f"frames sent to shard {s}'s worker",
+            ),
+            _METRICS.counter(
+                f"mesh.pipe.{s}.frames_in",
+                f"frames received from shard {s}'s worker",
+            ),
+            _METRICS.histogram(
+                f"mesh.pipe.{s}.serialize_ms",
+                f"controller-side pickle time per frame to shard {s}",
+            ),
+            _METRICS.histogram(
+                f"mesh.pipe.{s}.deserialize_ms",
+                f"controller-side unpickle time per frame from shard {s}",
+            ),
+        )
+        _PIPE_INSTRUMENTS[s] = m
+    return m
+
+
+def _pipe_recorder(s: int):
+    """The ``on_pipe`` callback for shard ``s``'s WorkerHandle: cheap
+    no-op while metrics are disabled, full accounting otherwise."""
+
+    def on_pipe(direction: str, nbytes: int, pickle_s: float) -> None:
+        if not _METRICS.enabled:
+            return
+        b_out, b_in, f_out, f_in, ser_ms, deser_ms = _pipe_instruments(s)
+        if direction == "out":
+            b_out.inc(nbytes)
+            f_out.inc()
+            ser_ms.observe(pickle_s * 1000.0)
+        else:
+            b_in.inc(nbytes)
+            f_in.inc()
+            deser_ms.observe(pickle_s * 1000.0)
+
+    return on_pipe
 
 
 def _route(num_docs: int, num_shards: int) -> np.ndarray:
@@ -419,6 +481,7 @@ class MeshFarm:
                     spec, timeout=worker_timeout, defer_ready=True,
                     on_delta=_METRICS.merge_frame, on_rpc=_M_W_RPCS.inc,
                     on_flight=_absorb_worker_events,
+                    on_pipe=_pipe_recorder(spec["shard"]),
                 )
                 for spec in specs
             ]
@@ -648,8 +711,9 @@ class MeshFarm:
         # the controller's trace ids. None when observability is off — the
         # disabled path ships nothing extra.
         obs = None
-        if _FLIGHT.enabled or _METRICS.enabled:
-            obs = {"flight": _FLIGHT.enabled, "exemplar": current_exemplar()}
+        if _FLIGHT.enabled or _METRICS.enabled or _OBSERVATORY.enabled:
+            obs = {"flight": _FLIGHT.enabled, "prof": _OBSERVATORY.enabled,
+                   "exemplar": current_exemplar()}
         groups = {s: [] for s in touched}
         for d in active:
             groups[shard_of[d]].append(

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import pickle
 import signal
 import time
 
@@ -117,6 +118,12 @@ def _worker_main(conn, spec: dict) -> None:
     metrics = get_metrics()  # amlint: disable=AM502 — same shipping buffer
     metrics.enable()
     flight = get_flight()  # amlint: disable=AM502,AM305 — shipping buffer
+    # amlint: disable=AM502 — the worker's own observatory: per-program
+    # compile/dispatch counters land in the worker registry and ship home
+    # through the same metrics delta as everything else
+    from ..obs.prof import get_observatory
+
+    observatory = get_observatory()  # amlint: disable=AM502 — see above
     flight.shard = spec["shard"]
     flight.epoch = spec.get("epoch", 0)
     blackbox_path = spec.get("blackbox_path")
@@ -184,6 +191,7 @@ def _worker_main(conn, spec: dict) -> None:
                 # ambient dispatch-span id for exemplar stamping
                 obs = payload[3] if len(payload) > 3 else None
                 flight.enabled = bool(obs and obs.get("flight"))
+                observatory.enabled = bool(obs and obs.get("prof"))
                 with exemplar_context(obs.get("exemplar") if obs else None):
                     resp = _do_apply(
                         farm, payload, PhaseProfile, use_profile,
@@ -331,15 +339,20 @@ class WorkerHandle:
 
     ``on_delta`` receives each response's metric delta frame;
     ``on_flight`` receives each response's shipped flight-event tail;
-    ``on_rpc`` fires once per request (all injected by meshfarm so this
-    module never touches the controller's process-global registries).
+    ``on_rpc`` fires once per request; ``on_pipe`` receives
+    ``(direction, frame_bytes, pickle_seconds)`` for every frame the
+    handle moves — the mesh pickle tax, measured (all injected by
+    meshfarm so this module never touches the controller's
+    process-global registries). With ``on_pipe`` set the handle pickles
+    frames explicitly (``Connection.send`` == ``send_bytes(dumps(...))``,
+    so the child's native protocol is unchanged).
 
     ``last_ok`` is the monotonic timestamp of the last successful
     response (readiness counts) — ``heartbeat_age()`` is what the crash
     event reports as "how long was this worker silent"."""
 
     def __init__(self, spec: dict, timeout: float | None = None,
-                 on_delta=None, on_rpc=None, on_flight=None,
+                 on_delta=None, on_rpc=None, on_flight=None, on_pipe=None,
                  defer_ready: bool = False):
         self.spec = spec
         if timeout is None:
@@ -348,6 +361,7 @@ class WorkerHandle:
         self._on_delta = on_delta
         self._on_rpc = on_rpc
         self._on_flight = on_flight
+        self._on_pipe = on_pipe
         self.conn = None
         self.proc = None
         self._ready = False
@@ -464,19 +478,32 @@ class WorkerHandle:
                 raise self._crash(f"no response within {timeout:.0f}s")
             try:
                 if self.conn.poll(min(0.2, remaining)):
-                    return self.conn.recv()
+                    return self._recv_frame()
             except (EOFError, OSError) as e:
                 raise self._crash(f"pipe closed mid-receive ({e!r})") from e
             if not self.proc.is_alive():
                 # drain a final message the worker flushed before dying
                 try:
                     if self.conn.poll(0):
-                        return self.conn.recv()
+                        return self._recv_frame()
                 except (EOFError, OSError):
                     pass
                 raise self._crash(
                     f"process died (exitcode {self.proc.exitcode})"
                 )
+
+    def _recv_frame(self):
+        """One frame off the pipe. ``Connection.recv`` IS
+        ``loads(recv_bytes())``; splitting the two steps when ``on_pipe``
+        is injected makes the frame size and deserialize time observable
+        without changing the wire format."""
+        if self._on_pipe is None:
+            return self.conn.recv()
+        buf = self.conn.recv_bytes()
+        t0 = time.perf_counter()
+        msg = pickle.loads(buf)
+        self._on_pipe("in", len(buf), time.perf_counter() - t0)
+        return msg
 
     def request(self, op: str, payload=None) -> None:
         if self._on_rpc is not None:
@@ -484,7 +511,15 @@ class WorkerHandle:
         if self.conn is None:
             raise self._crash("not running")
         try:
-            self.conn.send((op, payload))
+            if self._on_pipe is None:
+                self.conn.send((op, payload))
+            else:
+                t0 = time.perf_counter()
+                buf = pickle.dumps((op, payload),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+                ser_s = time.perf_counter() - t0
+                self.conn.send_bytes(buf)
+                self._on_pipe("out", len(buf), ser_s)
         except (OSError, BrokenPipeError, ValueError) as e:
             raise self._crash(f"pipe closed mid-send ({e!r})") from e
 

@@ -25,7 +25,6 @@ which preserves (counter, actor) ordering for up to 2^20 actors and 2^43 ops.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -34,7 +33,9 @@ import numpy as np
 
 from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
+from ..obs.prof import get_observatory
 from ..testing.faults import fire as _fault_point
+from .jitprof import profiled_jit
 
 PAD_KEY = jnp.iinfo(jnp.int32).max
 ACTOR_BITS = 20
@@ -71,43 +72,30 @@ _M_STATE_GROWS = _METRICS.counter(
 # recompile storm or a surprise slab doubling explains a latency cliff.
 _FLIGHT = get_flight()
 
-
-def _shape_bucket(args, kwargs):
-    """The (sorted, deduplicated) array shapes of a dispatch's arguments —
-    the compile-cache key's footprint, recorded on recompile events so the
-    flight timeline names WHICH shape bucket missed."""
-    shapes = {
-        tuple(leaf.shape)
-        for leaf in jax.tree_util.tree_leaves((args, kwargs))
-        if hasattr(leaf, "shape")
-    }
-    return sorted(shapes)
+# amprof observatory (obs/prof.py): every jit program below registers a
+# named ProfiledProgram via tpu/jitprof.py, so recompiles carry program
+# identity and dispatches get per-program latency attribution.
+_OBSERVATORY = get_observatory()
 
 
-def _dispatch(jitted, *args, **kwargs):
-    """Runs a jitted entry point, classifying the call as a jit cache hit
-    or a recompile by the growth of the function's compile cache across the
-    call. This is the single device-dispatch funnel for the engine, so the
-    recompile-storm and dispatch-count metrics cover every merge and
-    visibility program; with metrics disabled it degrades to a plain call."""
-    if not _METRICS.enabled:
-        return jitted(*args, **kwargs)
-    size_fn = getattr(jitted, "_cache_size", None)
-    before = size_fn() if size_fn is not None else -1
-    out = jitted(*args, **kwargs)
-    _M_DISPATCHES.inc()
-    if size_fn is not None:
-        grew = size_fn() - before
+def _dispatch(prog, *args, **kwargs):
+    """Runs a named profiled program (tpu/jitprof.py), classifying the
+    call as a jit cache hit or a recompile by the growth of the program's
+    compile cache across the call. This is the single device-dispatch
+    funnel for the engine, so the recompile-storm and dispatch-count
+    metrics cover every merge and visibility program. Per-program
+    attribution (compile/dispatch tallies, shape buckets, the
+    ``engine.recompile`` flight event with program identity) lives in
+    ``ProfiledProgram.call_profiled``; with both metrics and the
+    observatory disabled this degrades to a plain call."""
+    if not _METRICS.enabled and not _OBSERVATORY.enabled:
+        return prog.fn(*args, **kwargs)
+    out, grew, _dt = prog.call_profiled(args, kwargs)
+    if _METRICS.enabled:
+        _M_DISPATCHES.inc()
         if grew > 0:
             _M_JIT_RECOMPILES.inc(grew)
-            if _FLIGHT.enabled:
-                _FLIGHT.record(
-                    "engine.recompile",
-                    fn=getattr(jitted, "__name__", repr(jitted)),
-                    shapes=_shape_bucket(args, kwargs),
-                    cache_size=size_fn(),
-                )
-        else:
+        elif grew == 0:
             _M_JIT_HITS.inc()
     return out
 
@@ -257,7 +245,7 @@ def _merge_one_doc(s_key, s_op, s_action, s_value, s_pred, s_over, num_ops,
     return out_key, out_op, out_action, out_value, out_pred, out_over, new_num
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@profiled_jit("engine.apply_ops", donate_argnums=(0,))
 def batched_apply_ops(state: BatchedDocState, changes: ChangeOpsBatch) -> BatchedDocState:
     """applyChanges over a whole document batch: one fused XLA program,
     vmapped over the doc axis."""
@@ -333,7 +321,7 @@ def _visible_state_one_doc(key, op, action, value, pred, over, cmp):
     return key, op, visible_set, winner, value_total
 
 
-@jax.jit
+@profiled_jit("engine.visible_cmp")
 def _batched_visible_state_cmp(state: BatchedDocState, cmp):
     return jax.vmap(_visible_state_one_doc)(
         state.key, state.op, state.action, state.value, state.pred,
@@ -359,7 +347,7 @@ def batched_visible_state(state: BatchedDocState, actor_rank=None):
     return _dispatch(_batched_visible_state_cmp, state, cmp)
 
 
-@jax.jit
+@profiled_jit("engine.gather_rows")
 def _gather_rows(visible, totals, idx):
     """Row gather for the incremental readback path: `idx` is a flat array
     of ``doc * capacity + row`` indices (padded to a power-of-two length so

@@ -29,7 +29,6 @@ merit).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -37,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import PAD_KEY, _merge_one_doc, _visible_state_one_doc, remap_opid_actors
+from .jitprof import profiled_jit
 
 
 class SlabState(NamedTuple):
@@ -157,7 +157,8 @@ def _gather_pages(slab: SlabState, page_idx, page_size: int):
     return tuple(g(col) for col in slab)
 
 
-@partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0,))
+@profiled_jit("paging.apply_ops", static_argnames=("page_size",),
+              donate_argnums=(0,))
 def paged_apply_ops(slab: SlabState, gather_pages, changes, dest_pages, *,
                     page_size: int) -> SlabState:
     """applyChanges over the active documents: gather their pages from the
@@ -190,7 +191,7 @@ def paged_apply_ops(slab: SlabState, gather_pages, changes, dest_pages, *,
     )
 
 
-@partial(jax.jit, static_argnames=("page_size",))
+@profiled_jit("paging.probe_ops", static_argnames=("page_size",))
 def paged_probe_ops(slab: SlabState, gather_pages, changes, *, page_size: int):
     """The merge WITHOUT the scatter (and without donation): bisection
     probes run the suspect subset against the live slab on a throwaway
@@ -205,7 +206,7 @@ def paged_probe_ops(slab: SlabState, gather_pages, changes, *, page_size: int):
     )
 
 
-@partial(jax.jit, static_argnames=("page_size",))
+@profiled_jit("paging.visible_plain", static_argnames=("page_size",))
 def paged_visible_plain(slab: SlabState, gather_pages, *, page_size: int):
     key, op, action, value, pred, over = _gather_pages(
         slab, gather_pages, page_size
@@ -213,7 +214,7 @@ def paged_visible_plain(slab: SlabState, gather_pages, *, page_size: int):
     return jax.vmap(_visible_state_one_doc)(key, op, action, value, pred, over, op)
 
 
-@partial(jax.jit, static_argnames=("page_size",))
+@profiled_jit("paging.visible_ranked", static_argnames=("page_size",))
 def paged_visible_ranked(slab: SlabState, gather_pages, actor_rank, *,
                          page_size: int):
     key, op, action, value, pred, over = _gather_pages(
@@ -223,7 +224,7 @@ def paged_visible_ranked(slab: SlabState, gather_pages, actor_rank, *,
     return jax.vmap(_visible_state_one_doc)(key, op, action, value, pred, over, cmp)
 
 
-@jax.jit
+@profiled_jit("paging.patch_column_rows")
 def patch_column_rows(visible, totals, op, actor_rank, idx, cut):
     """Row gather + device patch emission for the scoped readback:
     `visible`/`totals`/`op` are the paged visibility outputs
@@ -243,13 +244,14 @@ def patch_column_rows(visible, totals, op, actor_rank, idx, cut):
     return v, t, patch_emit_columns(v, lam, cut)
 
 
-@partial(jax.jit, static_argnames=("page_size",))
+@profiled_jit("paging.dense_view", static_argnames=("page_size",))
 def paged_dense_view(slab: SlabState, gather_pages, *, page_size: int):
     """Dense [D, W] gather of all six columns (parity/debug readback)."""
     return _gather_pages(slab, gather_pages, page_size)
 
 
-@partial(jax.jit, static_argnames=("page_size",), donate_argnums=(0,))
+@profiled_jit("paging.adopt_rows", static_argnames=("page_size",),
+              donate_argnums=(0,))
 def paged_adopt_rows(slab: SlabState, dest_pages, key, op, action, value,
                      pred, over, *, page_size: int) -> SlabState:
     """Installs externally prepared rows (a migrated document) into freshly

@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..sync import NUM_PROBES
+from .jitprof import profiled_jit
 
 WORD_BITS = 32
 _LANES = 128
@@ -108,7 +109,7 @@ def _bloom_query_kernel(words_ref, modulo_ref, xyz_ref, out_ref, *, num_words):
         out_ref[0] = out_ref[0] | bit_set
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+@profiled_jit("pallas.bloom_query", static_argnames=("interpret",))
 def bloom_query(words, modulo, counts, query_xyz, *, interpret=False):
     """Pallas analogue of sync_batch.query_filters.
 
@@ -216,7 +217,8 @@ def _leb_segsum_kernel(planes_ref, seg_ref, out_ref):
         out_ref[...] = out_ref[...] + partial_sums
 
 
-@partial(jax.jit, static_argnames=("num_segments", "interpret"))
+@profiled_jit("pallas.leb128_segment_sum",
+              static_argnames=("num_segments", "interpret"))
 def leb128_segment_sum(planes, seg_ids, num_segments: int, *, interpret=False):
     """Per-varint payload-plane sums for the vectorized LEB128 decode
     (tpu/decode.leb128_scan_device): ``out[v, p] = sum(planes[i, p] for i
@@ -252,7 +254,7 @@ def leb128_segment_sum(planes, seg_ids, num_segments: int, *, interpret=False):
     return out[:num_segments]
 
 
-@partial(jax.jit, static_argnames=("num_words", "interpret"))
+@profiled_jit("pallas.bloom_build", static_argnames=("num_words", "interpret"))
 def bloom_build(xyz, counts, num_words: int, *, interpret=False):
     """Pallas analogue of sync_batch.build_filters.
 

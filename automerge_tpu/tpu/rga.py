@@ -49,6 +49,7 @@ import jax.numpy as jnp
 
 from ..errors import PackingLimitError
 from .engine import remap_opid_actors
+from .jitprof import profiled_jit
 
 # Packed opIds are (counter << 20 | actor), 44 significant bits. The
 # sibling-sort composite packs (parent+1) above them, so documents are
@@ -148,7 +149,7 @@ def _rga_rank_one_doc(parent, opid, valid):
     return rank_sorted[inv_order].astype(jnp.int32)
 
 
-@jax.jit
+@profiled_jit("rga.rank")
 def batched_rga_rank(parent, opid, valid, actor_rank):
     """Document-order ranks for a batch of list objects.
 

@@ -85,10 +85,10 @@ def _probe_positions(xyz, modulo):
     return jnp.concatenate([x[None], rest], axis=0)  # [NUM_PROBES, ...]
 
 
-from functools import partial
+from .jitprof import profiled_jit
 
 
-@partial(jax.jit, static_argnums=(2,))
+@profiled_jit("sync.build_filters", static_argnums=(2,))
 def build_filters(xyz, counts, num_words: int = None):
     """Builds B Bloom filters at once. xyz: [B, E, 3] uint32; counts: [B].
     Returns (words [B, W] uint32, modulo [B] int32)."""
@@ -115,7 +115,7 @@ def build_filters(xyz, counts, num_words: int = None):
     return words, modulo
 
 
-@jax.jit
+@profiled_jit("sync.query_filters")
 def query_filters(words, modulo, counts, query_xyz):
     """Tests C candidate hashes against each of B filters in one shot.
     query_xyz: [B, C, 3] uint32. Returns contained: [B, C] bool (False for

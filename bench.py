@@ -25,6 +25,18 @@ PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 CHILD_RETRIES = int(os.environ.get("BENCH_RETRIES", "2"))
 
 
+def _ledger_append(record):
+    """Appends a normalized perf record to the ledger (obs/ledger.py).
+    AM_LEDGER overrides the path; AM_LEDGER=0 (or empty) disables the
+    append entirely — the gates never depend on the ledger existing."""
+    path = os.environ.get("AM_LEDGER", os.path.join(_REPO, "ledger.jsonl"))
+    if not path or path == "0":
+        return
+    from automerge_tpu.obs.ledger import append_record
+
+    append_record(path, record)
+
+
 def bench_device(num_docs, capacity, rounds, ops_per_round, seed=0):
     import jax
     import jax.numpy as jnp
@@ -319,6 +331,8 @@ def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
       host cache); a revert to full readback makes skipped collapse to 0.
     """
     from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.obs.prof import (Sampler, enabled_observatory,
+                                        get_observatory)
     from automerge_tpu.profiling import PhaseProfile, use_profile
     from automerge_tpu.tpu.farm import TpuDocFarm
 
@@ -332,12 +346,22 @@ def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
 
     metrics = get_metrics()
     metrics.reset()
+    observatory = get_observatory()
+    observatory.reset()  # seeding compiles are warm-up; attribute deltas only
     prof = PhaseProfile()
     start = time.perf_counter()
-    with use_profile(prof), enabled_metrics():
+    with use_profile(prof), enabled_metrics(), enabled_observatory():
         for buf in buffers[seed_rounds:]:
             farm.apply_changes([[buf]] * num_docs)
     elapsed = time.perf_counter() - start
+
+    programs = {
+        name: {"compiles": s["compiles"], "dispatches": s["dispatches"],
+               "dispatch_ms": s["dispatch_ms"]}
+        for name, s in observatory.table().items()
+    }
+    mem = Sampler().sample(farm=farm)
+    mem.pop("t", None)
 
     phases = {
         name: round(entry["total_s"], 4)
@@ -372,6 +396,8 @@ def bench_smoke(num_docs=128, seed_rounds=6, seed_ops=48, delta_rounds=6,
         "device_patch_columns": _value("farm.patch.device_columns"),
         "decode_cache_hits": _value("codecs.decode_cache.hits"),
         "decode_cache_misses": _value("codecs.decode_cache.misses"),
+        "programs": programs,
+        "mem": mem,
     }
 
 
@@ -457,18 +483,41 @@ def _quick_main():
     the visibility+patch_assembly share or the gate+assembly share
     (gate_verdicts + transcode_columns + gate+transcode + patch_assembly
     — the phases the columnar gate retired from host Python) exceeds its
-    pinned threshold, or the scoped readback stops being incremental."""
+    pinned threshold, or the scoped readback stops being incremental, or
+    any compiled program recompiles more than BENCH_PROF_COMPILE_BUDGET
+    times during the steady-state delta rounds (the amprof observatory's
+    per-program attribution — a shape-bucket regression shows up as one
+    named program blowing its budget, not as an anonymous recompile
+    counter). The run appends its normalized record to the perf ledger
+    (see _ledger_append / `python -m automerge_tpu.obs --ledger`)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # host gate: no TPU needed
     num_docs = int(os.environ.get("BENCH_SMOKE_DOCS", "128"))
     threshold = float(os.environ.get("BENCH_SMOKE_MAX_TAIL_SHARE", "0.55"))
     gate_max = float(os.environ.get("BENCH_SMOKE_MAX_GATE_SHARE", "0.45"))
+    compile_budget = int(os.environ.get("BENCH_PROF_COMPILE_BUDGET", "2"))
     result = bench_smoke(num_docs)
     incremental = result["readback_rows_skipped"] > result["readback_rows"]
+    over_budget = {
+        name: s["compiles"]
+        for name, s in result["programs"].items()
+        if s["compiles"] > compile_budget
+    }
     ok = (
         result["tail_share"] <= threshold
         and result["gate_share"] <= gate_max
         and incremental
+        and not over_budget
+        and bool(result["programs"])  # attribution must actually populate
     )
+    _ledger_append({
+        "kind": "quick",
+        "config": {"docs": num_docs, "bench": "smoke"},
+        "ops_per_sec": round(result["ops_per_sec"]),
+        "phases": result["phases"],
+        "programs": result["programs"],
+        "mem": result["mem"],
+        "ok": ok,
+    })
     print(json.dumps({
         "metric": "visibility+patch_assembly share of delta-round time",
         "value": result["tail_share"],
@@ -482,6 +531,10 @@ def _quick_main():
         "vector_changes": result["vector_changes"],
         "gate_oracle_docs": result["gate_oracle_docs"],
         "device_patch_columns": result["device_patch_columns"],
+        "programs": result["programs"],
+        "prof_compile_budget": compile_budget,
+        "prof_over_budget": over_budget,
+        "mem": result["mem"],
         "ok": ok,
         "ops_per_sec": round(result["ops_per_sec"]),
         "phases_s": result["phases"],
@@ -735,6 +788,7 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
         obs_stack.enter_context(enabled_metrics())
     if observability == "full":
         from automerge_tpu.obs.flight import enabled_flight
+        from automerge_tpu.obs.prof import enabled_observatory, get_observatory
         from automerge_tpu.obs.slo import (
             SLOEngine,
             default_mesh_slos,
@@ -742,6 +796,8 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
         )
 
         obs_stack.enter_context(enabled_flight())
+        get_observatory().reset()
+        obs_stack.enter_context(enabled_observatory())
         slo_engine = SLOEngine(default_mesh_slos())
         slo_engine.sample()
     elif observability not in ("metrics", "off"):
@@ -763,10 +819,27 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
     elapsed = time.perf_counter() - start
     total_ops = num_docs * rounds * ops_per_round
 
-    from automerge_tpu.obs.export import shard_table
+    from automerge_tpu.obs.export import program_table, shard_table
 
     snap = metrics.as_dict()
     shards = shard_table(snap)  # the same pivot the --watch view renders
+    # per-shard pipe traffic (mesh.pipe.<s>.* — the pickle tax, process
+    # backend only) and per-program compile/dispatch attribution (the
+    # workers' amprof counters ship home through the metrics delta)
+    pipe = {}
+    for s, row in shards.items():
+        traffic = {
+            key[len("pipe."):]: val
+            for key, val in row.items()
+            if key.startswith("pipe.") and not isinstance(val, dict)
+        }
+        for hist in ("serialize_ms", "deserialize_ms"):
+            cell = row.get(f"pipe.{hist}")
+            if isinstance(cell, dict):
+                traffic[hist] = round(cell.get("sum", 0.0), 3)
+        if traffic:
+            pipe[str(s)] = traffic
+    programs = program_table(snap)
     per_shard = {}
     all_dispatched = True
     for s in range(num_shards):
@@ -858,6 +931,8 @@ def bench_mesh(num_docs, rounds, ops_per_round, seed=0, quick=False,
         },
         "worker_metrics": worker_metrics,
         "per_shard": per_shard,
+        "pipe": pipe,
+        "programs": programs,
         "phases_s": {
             name: round(entry["total_s"], 4)
             for name, entry in prof.as_dict().items()
@@ -928,6 +1003,27 @@ def _mesh_child_main():
             and obs_overhead["ratio"] <= obs_overhead["cap"]
             and result["slo"]["ok"]
         )
+        if backend == "process":
+            # pickle-tax budget: total pipe bytes (out + in) per shard per
+            # round must stay within the pinned envelope — a fatter wire
+            # format or an accidental full-state ship blows it immediately.
+            # Machine-independent: byte counts, not wall time.
+            pipe_budget = float(os.environ.get(
+                "BENCH_MESH_PIPE_BYTES_PER_ROUND", "200000"))
+            per_round = {
+                s: (t.get("bytes_out", 0) + t.get("bytes_in", 0))
+                / result["rounds"]
+                for s, t in result["pipe"].items()
+            }
+            result["pipe_bytes_per_round"] = {
+                s: round(v) for s, v in per_round.items()
+            }
+            result["pipe_bytes_per_round_budget"] = round(pipe_budget)
+            ok = (
+                ok
+                and bool(per_round)  # accounting must actually populate
+                and all(v <= pipe_budget for v in per_round.values())
+            )
     elif backend == "process":
         # the scaling gates are physical: N shard host phases can only
         # overlap on >= N usable cores, and per-shard PHASE wall-times on
@@ -968,6 +1064,16 @@ def _mesh_child_main():
             and result["scaling"]["device_dispatch"] >= dd_floor
         )
     result["ok"] = ok
+    _ledger_append({
+        "kind": f"mesh-{backend}" + ("-quick" if quick else ""),
+        "config": {"docs": num_docs, "rounds": rounds, "ops": ops,
+                   "backend": backend, "shards": result["num_shards"]},
+        "ops_per_sec": result["aggregate_ops_per_sec"],
+        "phases": result["phases_s"],
+        "programs": result["programs"],
+        "pipe": result["pipe"],
+        "ok": ok,
+    })
     print("BENCH_RESULT " + json.dumps(result))
 
 

@@ -1,8 +1,9 @@
 """AM201 clean fixture: data-dependent select stays on device."""
 import jax
+from jax import jit
 import jax.numpy as jnp
 
 
-@jax.jit
+@jit
 def relu(x):
     return jnp.where(x > 0, x, jnp.zeros_like(x))

@@ -1,9 +1,10 @@
 """AM201 suppressed fixture."""
 import jax
+from jax import jit
 import jax.numpy as jnp
 
 
-@jax.jit
+@jit
 def relu(x):
     if x > 0:  # amlint: disable=AM201
         return x

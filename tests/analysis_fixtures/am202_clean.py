@@ -1,8 +1,9 @@
 """AM202 clean fixture: device math stays in jax.numpy."""
 import jax
+from jax import jit
 import jax.numpy as jnp
 
 
-@jax.jit
+@jit
 def total(x):
     return jnp.sum(x)

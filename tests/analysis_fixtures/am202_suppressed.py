@@ -1,8 +1,9 @@
 """AM202 suppressed fixture."""
 import jax
+from jax import jit
 import numpy as np
 
 
-@jax.jit
+@jit
 def total(x):
     return np.asarray(x).sum()  # amlint: disable=AM202

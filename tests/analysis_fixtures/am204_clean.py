@@ -1,8 +1,9 @@
 """AM204 clean fixture: traced code builds only local state."""
 import jax
+from jax import jit
 
 
-@jax.jit
+@jit
 def record(x):
     parts = []
     parts.append(x)

@@ -1,10 +1,11 @@
 """AM204 suppressed fixture."""
 import jax
+from jax import jit
 
 _seen = []
 
 
-@jax.jit
+@jit
 def record(x):
     _seen.append(x)  # amlint: disable=AM204
     return x

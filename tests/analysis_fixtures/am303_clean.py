@@ -1,10 +1,11 @@
 """AM303 clean fixture: recording happens on the host, around the dispatch."""
 import jax
+from jax import jit
 
 from automerge_tpu.obs.metrics import get_metrics
 
 
-@jax.jit
+@jit
 def merge(x):
     return x * 2
 

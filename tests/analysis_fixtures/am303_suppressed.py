@@ -1,10 +1,11 @@
 """AM303 suppressed fixture."""
 import jax
+from jax import jit
 
 from automerge_tpu.obs.metrics import get_metrics
 
 
-@jax.jit
+@jit
 def merge(x):
     get_metrics().counter("merge.calls").inc()  # amlint: disable=AM303
     return x * 2

@@ -1,0 +1,10 @@
+"""Fixture: compiled programs registered through the amprof observatory
+— the blessed shape AM306 checks for."""
+from automerge_tpu.tpu.jitprof import profiled_jit
+
+
+@profiled_jit("fixture.merge_rows", static_argnames=("page_size",))
+def merge_rows(state, batch, page_size):
+    """Named program: compiles, dispatch latencies and shape buckets all
+    land under ``prof.program.fixture.merge_rows.*``."""
+    return state + batch

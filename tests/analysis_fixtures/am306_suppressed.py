@@ -1,0 +1,11 @@
+"""Fixture: justified ``# amlint: unprofiled-jit`` escapes silence AM306
+— the marker is a line suppression with the same trailing/standalone
+placement as ``disable=``."""
+import jax
+
+# one-shot shape probe: compiled once at import, never dispatched on the
+# hot path, so observatory attribution would only add noise
+probe = jax.jit(lambda x: x * 2)  # amlint: unprofiled-jit — import-time probe
+
+# amlint: unprofiled-jit — microbench-only reference program
+reference = jax.jit(lambda x: x + 1)

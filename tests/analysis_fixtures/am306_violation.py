@@ -1,0 +1,21 @@
+"""Fixture: bare jax.jit references bypassing the amprof observatory
+(AM306). All three shapes fire — the decorator, the partial-wrapped
+decorator, and the direct call."""
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def merge_rows(state, batch):
+    """Anonymous compiled program: its recompiles surface with no
+    program name in the flight timeline."""
+    return state + batch
+
+
+@partial(jax.jit, static_argnums=(1,))
+def probe_rows(state, page_size):
+    return state * page_size
+
+
+gather = jax.jit(lambda state: state)

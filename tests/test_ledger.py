@@ -1,0 +1,167 @@
+"""Perf-ledger suite (automerge_tpu/obs/ledger.py + the obs CLI modes).
+
+The ledger is bench.py's regression memory: append-only normalized JSONL
+records, a trajectory renderer and a record differ. Pinned here:
+- normalize(): numpy scalars/arrays -> plain ints/floats/lists (the
+  np.int64-under-default=str stringification bug), nested containers,
+  unknown leaves stringified;
+- append/load round trip, config hashing (equal configs -> equal hashes,
+  the differ's comparability test), malformed-line tolerance;
+- diff_records: ops/s ratio, per-program compile/dispatch deltas
+  (zero-delta programs dropped), per-shard pipe deltas;
+- the ``python -m automerge_tpu.obs --ledger [--diff]`` CLI contract.
+"""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from automerge_tpu.obs.ledger import (
+    append_record,
+    config_hash,
+    diff_records,
+    load_ledger,
+    normalize,
+    render_diff,
+    render_trajectory,
+)
+
+
+def test_normalize_strips_numpy_scalars_and_arrays():
+    record = {
+        "a": np.int64(7),
+        "b": np.float32(0.5),
+        "c": np.arange(3, dtype=np.int64),
+        "d": {"nested": (np.int32(1), 2)},
+        "e": [True, None, "s"],
+    }
+    out = normalize(record)
+    assert out == {"a": 7, "b": 0.5, "c": [0, 1, 2],
+                   "d": {"nested": [1, 2]}, "e": [True, None, "s"]}
+    # the bug this guards: json.dumps(..., default=str) silently writes
+    # "7" instead of 7 for np.int64 — normalized records need no default
+    assert '"7"' not in json.dumps(out)
+    assert type(out["a"]) is int
+
+
+def test_normalize_stringifies_unknown_leaves():
+    class Opaque:
+        def __repr__(self):
+            return "<opaque>"
+
+    assert normalize({"x": Opaque()}) == {"x": "<opaque>"}
+
+
+def test_config_hash_is_order_independent_and_type_normalized():
+    assert config_hash({"a": 1, "b": 2}) == config_hash({"b": 2, "a": 1})
+    assert config_hash({"a": np.int64(1)}) == config_hash({"a": 1})
+    assert config_hash({"a": 1}) != config_hash({"a": 2})
+
+
+def test_append_and_load_round_trip(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    rec = append_record(path, {
+        "kind": "quick",
+        "config": {"docs": np.int64(128)},
+        "ops_per_sec": np.float64(1234.5),
+    })
+    assert rec["config_hash"] == config_hash({"docs": 128})
+    append_record(path, {"kind": "quick", "config": {"docs": 128},
+                         "ops_per_sec": 1300})
+    records = load_ledger(path)
+    assert len(records) == 2
+    assert records[0]["ops_per_sec"] == 1234.5
+    assert records[0]["config_hash"] == records[1]["config_hash"]
+
+
+def test_load_skips_malformed_lines(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text('{"kind": "quick"}\nnot json\n\n{"kind": "mesh"}\n')
+    assert [r["kind"] for r in load_ledger(path)] == ["quick", "mesh"]
+    assert load_ledger(tmp_path / "missing.jsonl") == []
+
+
+@pytest.fixture
+def two_records():
+    a = {
+        "kind": "quick", "config_hash": "abc", "ops_per_sec": 1000,
+        "programs": {
+            "paging.apply_ops": {"compiles": 1, "dispatches": 6},
+            "paging.visible_ranked": {"compiles": 0, "dispatches": 6},
+        },
+        "pipe": {"0": {"bytes_out": 100, "bytes_in": 3000,
+                       "frames_out": 1, "frames_in": 2}},
+    }
+    b = {
+        "kind": "quick", "config_hash": "abc", "ops_per_sec": 1100,
+        "programs": {
+            "paging.apply_ops": {"compiles": 4, "dispatches": 6},
+            "paging.visible_ranked": {"compiles": 0, "dispatches": 6},
+        },
+        "pipe": {"0": {"bytes_out": 100, "bytes_in": 3600,
+                       "frames_out": 1, "frames_in": 2}},
+    }
+    return a, b
+
+
+def test_diff_records_reports_deltas_and_drops_noise(two_records):
+    a, b = two_records
+    diff = diff_records(a, b)
+    assert diff["comparable"] is True
+    assert diff["ops_per_sec"]["delta"] == 100
+    assert diff["ops_per_sec"]["ratio"] == pytest.approx(1.1)
+    # only the program that actually moved appears
+    assert list(diff["programs"]) == ["paging.apply_ops"]
+    assert diff["programs"]["paging.apply_ops"]["compiles"] == 3
+    assert diff["pipe"]["0"]["bytes_in"] == 600
+    assert diff["pipe"]["0"]["bytes_out"] == 0
+
+
+def test_diff_flags_incomparable_configs(two_records):
+    a, b = two_records
+    b = dict(b, config_hash="zzz")
+    diff = diff_records(a, b)
+    assert diff["comparable"] is False
+    assert "[configs differ]" in render_diff(a, b)
+
+
+def test_render_trajectory_totals(two_records):
+    a, b = two_records
+    text = render_trajectory([a, b])
+    lines = text.splitlines()
+    assert len(lines) == 4  # header, rule, two rows
+    assert "1,000" in lines[2] and "1,100" in lines[3]
+    assert "3100" in lines[2]  # pipe bytes total of record 0
+    assert render_trajectory([]) == "ledger is empty"
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.obs", *argv],
+        capture_output=True, text=True,
+    )
+
+
+def test_cli_trajectory_diff_and_bounds(tmp_path, two_records):
+    path = tmp_path / "ledger.jsonl"
+    a, b = two_records
+    append_record(path, a)
+    append_record(path, b)
+
+    out = _run_cli("--ledger", str(path))
+    assert out.returncode == 0
+    assert "quick" in out.stdout and "1,100" in out.stdout
+
+    out = _run_cli("--ledger", str(path), "--diff", "-2", "-1")
+    assert out.returncode == 0
+    assert "paging.apply_ops: compiles +3" in out.stdout
+
+    out = _run_cli("--ledger", str(path), "--diff", "0", "9")
+    assert out.returncode == 1
+    assert "out of range" in out.stderr
+
+    out = _run_cli("--ledger", str(path), "--json")
+    assert out.returncode == 0
+    assert len(json.loads(out.stdout)) == 2

@@ -1,0 +1,403 @@
+"""amprof observatory suite (automerge_tpu/obs/prof.py + the export
+pivots it feeds).
+
+Covers the PR 14 acceptance contract:
+- ProfiledProgram: disabled fall-through (one attribute test), cache
+  growth -> compile attribution, dispatch tallies on an injected clock,
+  recompile flight events carrying program identity, re-registration
+  keeping tallies;
+- Observatory: storm detector (>= K compiles inside the window fires
+  ``prof.recompile.storm`` once and re-arms; a slow drizzle never
+  fires), table() plain-int stats, enabled_observatory state restore;
+- Sampler: slab/page math (occupancy, fragmentation from the free-list
+  run structure), DecodeCache and change-column byte accounting, and
+  the int-cast guarantee (np.int64 never reaches a sample dict — the
+  JSONL stringification bug);
+- export pivots: ``shard_table`` folding ``mesh.pipe.<s>.*`` rows in
+  alongside ``mesh.shard.<s>.*`` without shadowing the serving
+  ``serve.flush.shard.<s>.docs`` family, and ``program_table`` rolling
+  up ``prof.program.<name>.*``.
+"""
+import json
+
+import numpy as np
+
+from automerge_tpu.obs.export import program_table, shard_table
+from automerge_tpu.obs.flight import FlightRecorder
+from automerge_tpu.obs.metrics import MetricsRegistry
+from automerge_tpu.obs.prof import (
+    Observatory,
+    Sampler,
+    enabled_observatory,
+    get_observatory,
+    shape_bucket,
+)
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FakeJit:
+    """A jitted-function stand-in: every distinct arg shape grows the
+    tracing cache by one, like jax.jit's per-signature cache."""
+
+    __name__ = "fake_jit"
+
+    def __init__(self):
+        self.shapes = set()
+        self.calls = 0
+
+    def __call__(self, x, *rest, **kwargs):
+        self.calls += 1
+        self.shapes.add(getattr(x, "shape", None))
+        return x
+
+    def _cache_size(self):
+        return len(self.shapes)
+
+
+def make_observatory(**kwargs):
+    registry = MetricsRegistry(enabled=True)
+    flight = FlightRecorder(clock=lambda: 0.0)
+    flight.enabled = True
+    clock = ManualClock()
+    obs = Observatory(registry=registry, flight=flight, clock=clock, **kwargs)
+    return obs, registry, flight, clock
+
+
+def arr(*shape):
+    return np.zeros(shape, np.int32)
+
+
+# ---------------------------------------------------------------------- #
+# ProfiledProgram
+# ---------------------------------------------------------------------- #
+
+def test_disabled_program_falls_through_without_tallies():
+    obs, registry, _flight, _clock = make_observatory()
+    fn = FakeJit()
+    prog = obs.register("t.prog", fn)
+    out = prog(arr(4))
+    assert out.shape == (4,)
+    assert fn.calls == 1
+    assert prog.dispatches == 0 and prog.compiles == 0
+    assert "prof.program.t.prog.dispatches" not in registry.as_dict()
+
+
+def test_enabled_program_attributes_compiles_and_dispatches():
+    obs, registry, _flight, clock = make_observatory()
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+    prog(arr(4))          # new shape: compile
+    clock.t += 0.25
+    prog(arr(4))          # warm shape: plain dispatch
+    prog(arr(8))          # new shape: compile
+    assert prog.compiles == 2
+    assert prog.dispatches == 3
+    snap = registry.as_dict()
+    assert snap["prof.program.t.prog.compiles"]["value"] == 2
+    assert snap["prof.program.t.prog.dispatches"]["value"] == 3
+    assert snap["prof.program.t.prog.dispatch_ms"]["count"] == 3
+
+
+def test_dispatch_wall_time_reads_the_injected_clock():
+    obs, _registry, _flight, clock = make_observatory()
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+
+    original = prog.fn
+
+    def slow(x):
+        clock.t += 0.5
+        return original(x)
+
+    prog.fn = slow
+    prog(arr(4))
+    assert prog.stats()["dispatch_ms"] == 500.0
+
+
+def test_recompile_event_carries_program_identity():
+    obs, _registry, flight, _clock = make_observatory()
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+    prog(arr(4), arr(2, 2))
+    events = [e for e in flight.snapshot() if e["event"] == "engine.recompile"]
+    assert len(events) == 1
+    fields = events[0]["fields"]
+    assert fields["program"] == "t.prog"
+    assert fields["fn"] == "fake_jit"
+    assert fields["cache_size"] == 1
+    assert [tuple(s) for s in fields["shapes"]] == [(2, 2), (4,)]
+
+
+def test_recompile_event_fires_even_when_observatory_disabled():
+    """Flight emission replaces the old engine._dispatch probe, which was
+    gated on the flight recorder alone — the observatory flag only
+    gates the tallies/instruments."""
+    obs, _registry, flight, _clock = make_observatory()
+    prog = obs.register("t.prog", FakeJit())
+    _out, grew, _dt = prog.call_profiled((arr(4),), {})
+    assert grew == 1
+    assert [e["event"] for e in flight.snapshot()] == ["engine.recompile"]
+    assert prog.dispatches == 0  # disabled: no tallies
+
+
+def test_unprobeable_fn_reports_minus_one_growth():
+    obs, _registry, flight, _clock = make_observatory()
+    prog = obs.register("t.plain", lambda x: x)
+    obs.enable()
+    _out, grew, _dt = prog.call_profiled((arr(4),), {})
+    assert grew == -1
+    assert prog.compiles == 0
+    assert len(flight) == 0
+
+
+def test_reregistration_rebinds_fn_but_keeps_tallies():
+    obs, _registry, _flight, _clock = make_observatory()
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+    prog(arr(4))
+    reloaded = FakeJit()
+    again = obs.register("t.prog", reloaded)
+    assert again is prog
+    assert prog.fn is reloaded
+    assert prog.compiles == 1
+
+
+def test_shape_bucket_walks_nested_containers():
+    bucket = shape_bucket(
+        (arr(4), [arr(2, 3), (arr(4),)]), {"k": {"n": arr(5)}})
+    assert bucket == [(2, 3), (4,), (5,)]
+    assert shape_bucket((1, "x"), {}) == []
+
+
+# ---------------------------------------------------------------------- #
+# Observatory: storm detector, table, context manager
+# ---------------------------------------------------------------------- #
+
+def test_storm_fires_once_and_rearms():
+    obs, _registry, flight, _clock = make_observatory(
+        storm_compiles=3, storm_window_s=10.0)
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+    for n in range(1, 6):
+        prog(arr(n))  # every call is a fresh shape: 5 compiles
+    storms = [e for e in flight.snapshot() if e["event"] == "prof.recompile.storm"]
+    # 3 compiles -> storm, detector clears, 2 more compiles stay below K
+    assert len(storms) == 1
+    fields = storms[0]["fields"]
+    assert fields["program"] == "t.prog"
+    assert fields["compiles"] == 3
+    assert fields["window_s"] == 10.0
+    assert fields["buckets"]  # the offending bucket sequence rides along
+
+
+def test_slow_compile_drizzle_never_storms():
+    obs, _registry, flight, clock = make_observatory(
+        storm_compiles=3, storm_window_s=10.0)
+    prog = obs.register("t.prog", FakeJit())
+    obs.enable()
+    for n in range(1, 7):
+        prog(arr(n))
+        clock.t += 6.0  # compiles 6s apart: never 3 inside a 10s window
+    assert not [e for e in flight.snapshot() if e["event"] == "prof.recompile.storm"]
+
+
+def test_table_reports_only_active_programs_as_plain_ints():
+    obs, _registry, _flight, _clock = make_observatory()
+    obs.register("t.idle", FakeJit())
+    prog = obs.register("t.busy", FakeJit())
+    obs.enable()
+    prog(arr(4))
+    table = obs.table()
+    assert list(table) == ["t.busy"]
+    stats = table["t.busy"]
+    assert type(stats["compiles"]) is int
+    assert type(stats["dispatches"]) is int
+    assert stats["cache_size"] == 1
+    assert stats["buckets"] == [[[4]]]
+    json.dumps(table)  # fully serializable, no default= needed
+
+
+def test_enabled_observatory_restores_prior_state():
+    obs = get_observatory()
+    assert obs.enabled is False
+    with enabled_observatory():
+        assert obs.enabled is True
+        with enabled_observatory():
+            assert obs.enabled is True
+        assert obs.enabled is True
+    assert obs.enabled is False
+
+
+def test_global_registration_covers_the_tpu_programs():
+    """Importing the tpu layer registers every named program — the
+    observatory is the one place recompiles can be attributed, so the
+    roster is pinned here."""
+    import automerge_tpu.tpu.paging  # noqa: F401 - registration side effect
+    import automerge_tpu.tpu.sync_batch  # noqa: F401
+
+    names = set(get_observatory().programs())
+    assert {
+        "engine.apply_ops", "engine.visible_cmp", "engine.gather_rows",
+        "paging.apply_ops", "paging.probe_ops", "paging.visible_plain",
+        "paging.visible_ranked", "paging.patch_column_rows",
+        "paging.dense_view", "paging.adopt_rows",
+        "sync.build_filters", "sync.query_filters",
+    } <= names
+
+
+# ---------------------------------------------------------------------- #
+# Sampler
+# ---------------------------------------------------------------------- #
+
+class FakePages:
+    def __init__(self, allocated, free):
+        self._allocated = allocated
+        self._free = list(free)
+        self.page_size = np.int64(64)  # deliberately numpy: must be cast
+
+    @property
+    def allocated(self):
+        return np.int64(self._allocated)
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+
+class FakeEngine:
+    def __init__(self, pages, lengths):
+        self.pages = pages
+        self.lengths = np.asarray(lengths, np.int64)
+
+
+class FakeCols:
+    def __init__(self, nbytes, sorted_nbytes=0):
+        self.arr = np.zeros(nbytes, np.uint8)
+        self._sorted = (
+            (np.zeros(sorted_nbytes, np.uint8),) if sorted_nbytes else None)
+
+
+class FakeFarm:
+    def __init__(self, engine, cols_cache):
+        self.engine = engine
+        self._cols_cache = cols_cache
+
+
+def make_sampler():
+    registry = MetricsRegistry(enabled=True)
+    clock = ManualClock()
+    return Sampler(registry=registry, clock=clock), registry, clock
+
+
+def test_sampler_page_math_and_int_casts():
+    # free list {3,4,5, 9}: longest run 3 of 4 free -> fragmentation 0.25
+    engine = FakeEngine(FakePages(allocated=6, free=[9, 3, 5, 4]),
+                        lengths=[np.int64(100), np.int64(92)])
+    sampler, registry, _clock = make_sampler()
+    sample = sampler.sample(farm=FakeFarm(engine, {}))
+    assert sample["pages_allocated"] == 6
+    assert sample["pages_free"] == 4
+    assert sample["rows"] == 192
+    assert sample["occupancy"] == 0.5       # 192 rows / (6 * 64)
+    assert sample["fragmentation"] == 0.25  # 1 - 3/4
+    for key, value in sample.items():
+        assert not isinstance(value, np.generic), (key, type(value))
+    # the satellite bug: np.int64 leaves stringify under default=str
+    assert '"' not in json.dumps(list(sample.values()))
+    snap = registry.as_dict()
+    assert snap["prof.mem.pages.allocated"]["value"] == 6
+    assert snap["prof.mem.pages.fragmentation"]["value"] == 0.25
+
+
+def test_sampler_counts_change_col_bytes_and_sentinels():
+    engine = FakeEngine(FakePages(allocated=1, free=[]), lengths=[4])
+    cache = {
+        "a": FakeCols(100),
+        "b": FakeCols(40, sorted_nbytes=10),
+        "c": object(),  # uncacheable sentinel: counted, zero bytes
+    }
+    sampler, registry, _clock = make_sampler()
+    sample = sampler.sample(farm=FakeFarm(engine, cache))
+    assert sample["change_cols_bytes"] == 150
+    assert sample["change_cols_entries"] == 3
+    assert registry.as_dict()["prof.mem.change_cols.bytes"]["value"] == 150
+
+
+def test_sampler_ring_is_bounded():
+    engine = FakeEngine(FakePages(allocated=1, free=[]), lengths=[1])
+    sampler, _registry, clock = make_sampler()
+    sampler.samples = type(sampler.samples)(maxlen=4)
+    for _ in range(10):
+        clock.t += 1.0
+        sampler.sample(engine=engine)
+    assert len(sampler.samples) == 4
+    assert sampler.samples[-1]["t"] == 10.0
+
+
+def test_sampler_decode_cache_bytes_from_live_module():
+    from automerge_tpu.codecs import DecodeCache
+
+    cache = DecodeCache(4, name="prof-test")
+    cache.put(b"x" * 100, {"decoded": True})
+    sampler, _registry, _clock = make_sampler()
+    sample = sampler.sample()
+    assert sample["decode_cache_bytes"] >= 100
+    assert type(sample["decode_cache_bytes"]) is int
+    cache.clear()
+
+
+# ---------------------------------------------------------------------- #
+# export pivots
+# ---------------------------------------------------------------------- #
+
+def _hist(count, total):
+    return {"type": "histogram", "count": count, "sum": total, "p99": 1.0}
+
+
+def test_shard_table_pivots_pipe_rows_without_shadowing():
+    snapshot = {
+        "mesh.shard.0.docs": {"type": "counter", "value": 12},
+        "mesh.shard.0.dispatch_ms": _hist(3, 42.0),
+        "mesh.pipe.0.bytes_out": {"type": "counter", "value": 512},
+        "mesh.pipe.0.bytes_in": {"type": "counter", "value": 2048},
+        "mesh.pipe.0.serialize_ms": _hist(4, 1.5),
+        "serve.flush.shard.0.docs": {"type": "counter", "value": 7},
+        "mesh.pipe.1.bytes_out": {"type": "counter", "value": 99},
+        "farm.changes.applied": {"type": "counter", "value": 5},
+    }
+    table = shard_table(snapshot)
+    assert sorted(table) == [0, 1]
+    row = table[0]
+    # three families, one row, no shadowing
+    assert row["docs"] == 12
+    assert row["pipe.bytes_out"] == 512
+    assert row["pipe.bytes_in"] == 2048
+    assert row["flush.docs"] == 7
+    assert row["pipe.serialize_ms"]["count"] == 4
+    assert row["dispatch_ms"]["sum"] == 42.0
+    assert table[1] == {"pipe.bytes_out": 99}
+
+
+def test_program_table_rolls_up_prof_rows():
+    snapshot = {
+        "prof.program.paging.apply_ops.compiles":
+            {"type": "counter", "value": 2},
+        "prof.program.paging.apply_ops.dispatches":
+            {"type": "counter", "value": 9},
+        "prof.program.paging.apply_ops.dispatch_ms": _hist(9, 123.4567),
+        "prof.program.sync.build_filters.dispatches":
+            {"type": "counter", "value": 3},
+        "mesh.shard.0.docs": {"type": "counter", "value": 1},
+    }
+    table = program_table(snapshot)
+    assert list(table) == ["paging.apply_ops", "sync.build_filters"]
+    assert table["paging.apply_ops"]["compiles"] == 2
+    assert table["paging.apply_ops"]["dispatch_ms"] == 123.457
+    assert table["sync.build_filters"] == {"dispatches": 3}
