@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers prof
+.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers prof store
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -72,6 +72,15 @@ mesh:
 # (tests/test_mesh_workers_smoke.py, tests/test_mesh_workers.py)
 mesh-workers:
 	$(PY) bench.py --mesh --quick --backend process
+
+# persistence-tier smoke (README "Persistence"): WAL-attached merge
+# round-trip, then both cold-start paths rebuilt from the on-disk log —
+# gates byte parity with the writer, a clean recovery report, and full
+# change accounting. The full STORE_r01 record run (batched hydration
+# >= 5x the per-doc load loop): `python bench.py --store`; the same
+# quick gates are tier-1 as tests/test_store_smoke.py
+store:
+	$(PY) bench.py --store --quick
 
 # amprof ledger smoke (README "Observability"): run the quick bench with
 # per-program compile/dispatch attribution + memory sampling, append the

@@ -26,6 +26,8 @@ from .errors import (
     PackingLimitError,
     QuarantinedError,
     RetryExhaustedError,
+    StoreCorruptError,
+    StoreTornWriteError,
     SyncFrameError,
     SyncProtocolError,
     WorkerCrashError,
@@ -70,6 +72,7 @@ __all__ = [
     "CausalityError", "PackingLimitError", "SyncProtocolError",
     "SyncFrameError", "RetryExhaustedError", "ChannelQuarantinedError",
     "QuarantinedError", "DeviceFaultError", "WorkerCrashError",
+    "StoreCorruptError", "StoreTornWriteError",
     "AdmissionRejectedError", "BackpressureError",
 ]
 

@@ -51,6 +51,13 @@ Rule families (see core.RULES for the catalog):
   (AM501); worker-executed modules importing the controller layer or
   touching process-global registry accessors — workers speak the pipe
   protocol and ship metric deltas explicitly (AM502).
+- **AM6xx durability**: bare write-mode ``open()``/``os.write`` in
+  durability-plane modules (``store/`` stems or files marked
+  ``# amlint: durability-plane``) — durable bytes flow only through
+  ``store.atomic.atomic_write`` (tmp + fsync + rename) or the WAL's
+  checksummed appender, so crash recovery can prove exactly what
+  committed; the two primitives themselves carry justified suppressions
+  (AM601).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -63,8 +70,8 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import (boundary, catalog, hotpath, meshrules, obsrules, packing,
-               profrules, taxonomy, tracer, workerrules)
+from . import (boundary, catalog, durability, hotpath, meshrules, obsrules,
+               packing, profrules, taxonomy, tracer, workerrules)
 from .core import RULES, FileContext, Finding, collect_files
 
 __all__ = [
@@ -97,7 +104,7 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
     for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
-                   hotpath, meshrules, workerrules, profrules):
+                   hotpath, meshrules, workerrules, profrules, durability):
         findings.extend(family.check(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:

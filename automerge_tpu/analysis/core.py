@@ -98,6 +98,12 @@ RULES: dict[str, tuple[str, str]] = {
                       "registry accessor (get_metrics/get_flight/...) — "
                       "workers speak the pipe protocol and record into "
                       "explicitly shipped sinks"),
+    "AM601": ("store", "bare write-mode open()/os.write in a durability-"
+                       "plane module (store/ or `# amlint: durability-"
+                       "plane`) — durable bytes go through "
+                       "store.atomic.atomic_write or the WAL's checksummed "
+                       "appender so recovery can prove the commit point; "
+                       "justify raw handles with a suppression"),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -18,7 +18,9 @@ Hierarchy::
 
     AutomergeError
     ├── DecodeError(ValueError)        structurally invalid bytes
-    │   └── ChecksumError              container checksum / hash mismatch
+    │   ├── ChecksumError              container checksum / hash mismatch
+    │   ├── StoreCorruptError          persisted segment fails its checksum/hash graph
+    │   └── StoreTornWriteError        torn/short frame at a WAL segment tail
     ├── EncodeError(ValueError)        unencodable value / malformed op dict
     ├── CausalityError(ValueError)     seq reuse/skip, unknown pred/dep/ref
     ├── PackingLimitError(ValueError)  merge-key / MAX_ELEMS / interner caps
@@ -50,6 +52,24 @@ class ChecksumError(DecodeError):
     """Container checksum (or change-hash) does not match the data."""
 
     kind = "checksum"
+
+
+class StoreCorruptError(DecodeError):
+    """A persisted store segment is structurally complete but wrong: a
+    frame checksum mismatch, a footer whose hash list disagrees with the
+    rebuilt graph, or a compacted chunk that fails verification. Recovery
+    quarantines the segment (and the documents it covers) rather than
+    aborting the open; the docs are repairable via sync redelivery."""
+
+    kind = "store_corrupt"
+
+
+class StoreTornWriteError(DecodeError):
+    """A short or torn frame at the tail of a write-ahead segment — the
+    signature of a crash mid-append. Recovery truncates the segment at the
+    last whole frame; everything before it is intact by construction."""
+
+    kind = "store_torn"
 
 
 class EncodeError(AutomergeError, ValueError):
@@ -151,3 +171,22 @@ def error_kind(exc: BaseException) -> str:
     """The ``error_kind`` dimension for one exception: the taxonomy class's
     ``kind``, or ``"other"`` for exceptions outside the taxonomy."""
     return getattr(exc, "kind", "other") if isinstance(exc, AutomergeError) else "other"
+
+
+_KIND_INDEX: dict[str, type] = {}
+
+
+def error_from_kind(kind: str, message: str) -> AutomergeError:
+    """Rebuilds a taxonomy exception from its persisted ``kind`` dimension.
+
+    The store's quarantine sidecar records causes as ``(kind, message)``
+    pairs; hydration turns them back into catchable exceptions of the
+    original class. Unknown kinds rebuild as the ``AutomergeError`` root
+    so a newer sidecar never crashes an older reader."""
+    if not _KIND_INDEX:
+        stack: list[type] = [AutomergeError]
+        while stack:
+            cls = stack.pop()
+            _KIND_INDEX.setdefault(cls.kind, cls)
+            stack.extend(cls.__subclasses__())
+    return _KIND_INDEX.get(kind, AutomergeError)(message)

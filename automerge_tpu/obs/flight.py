@@ -268,7 +268,12 @@ def write_blackbox(path: str, recorder: FlightRecorder,
     atomically (tmp + rename) after every worker delivery and on the
     worker fault path, so the file a crashed worker leaves behind is
     always a complete JSON document — a SIGKILL between deliveries cannot
-    tear it."""
+    tear it. The black box is advisory forensics on a per-delivery hot
+    path, so it skips the store tier's fsync (the WAL owns durability)."""
+    # Late import: the store package's WAL layer records flight events, so
+    # binding its atomic writer at call time keeps the import graph acyclic.
+    from ..store.atomic import atomic_write
+
     payload = {
         "pid": os.getpid(),
         "shard": recorder.shard,
@@ -276,10 +281,8 @@ def write_blackbox(path: str, recorder: FlightRecorder,
         "events": recorder.tail(BLACKBOX_TAIL),
         "phases": phases_jsonl,
     }
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, sort_keys=True, default=str)
-    os.replace(tmp, path)
+    atomic_write(path, json.dumps(payload, sort_keys=True, default=str),
+                 fsync=False)
 
 
 def read_blackbox(path: str) -> dict | None:

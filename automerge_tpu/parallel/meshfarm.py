@@ -411,7 +411,17 @@ class MeshFarm:
     `rebalance_interval` arms `rebalance_policy` ("page_load" or a
     callable taking the mesh) every that many applies. `warm_changes`
     (process backend) pre-compiles each worker's jit caches against a
-    throwaway farm before the readiness barrier lifts."""
+    throwaway farm before the readiness barrier lifts.
+
+    `store_dir` turns on the crash-consistent persistence tier
+    (automerge_tpu/store): each shard owns ``<store_dir>/shard-NNN`` —
+    workers (or inline shards) recover + hydrate from it on open, commit
+    every delivery through its WAL before acking, and a
+    ``_recover_worker`` respawn re-hydrates from disk instead of relying
+    only on the controller's in-memory delivery log. Store directories
+    deliberately survive ``close()`` — they ARE the durability story.
+    Controller-side mirrors (no-op patch clocks for never-touched docs)
+    reflect only deliveries this controller observed."""
 
     def __init__(self, num_docs: int, num_shards: int | None = None,
                  capacity: int = 1024, quarantine_threshold: int | None = 3,
@@ -422,7 +432,7 @@ class MeshFarm:
                  rebalance_policy="page_load",
                  rebalance_interval: int | None = None,
                  worker_timeout: float | None = None,
-                 warm_changes=None):
+                 warm_changes=None, store_dir: str | None = None):
         if mesh_backend is None:
             mesh_backend = os.environ.get("AM_MESH_BACKEND", "inline")
         if mesh_backend not in ("inline", "process"):
@@ -431,6 +441,14 @@ class MeshFarm:
             raise ValueError(
                 f"mesh_backend must be 'inline' or 'process', "
                 f"got {mesh_backend!r}"
+            )
+        if store_dir is not None and rebalance_interval:
+            # amlint: disable=AM401 — API-usage validation, not a
+            # data-plane fault (nothing was decoded or dispatched)
+            raise ValueError(
+                "store_dir with automatic rebalancing is unsupported: the "
+                "per-shard WAL is keyed by worker-local slots, which "
+                "migration re-assigns"
             )
         if num_shards is None:
             num_shards = len(devices) if devices else 1
@@ -472,6 +490,7 @@ class MeshFarm:
                 page_size=page_size, env=(), epoch=0,
                 blackbox_path=self._blackbox_path(s),
                 warm_buffers=tuple(warm_changes) if warm_changes else None,
+                store_dir=self._shard_store_dir(store_dir, s),
             ))
         if mesh_backend == "process":
             # start every worker before awaiting any readiness message,
@@ -493,11 +512,18 @@ class MeshFarm:
         else:
             for s, slots in enumerate(self._slots):
                 with self._device_ctx(s):
-                    self.shards.append(TpuDocFarm(
+                    farm = TpuDocFarm(
                         slots, capacity=capacity,
                         quarantine_threshold=quarantine_threshold,
                         page_size=page_size,
-                    ))
+                    )
+                    if specs[s]["store_dir"] is not None:
+                        from ..store import ShardStore, hydrate_farm
+
+                        shard_store = ShardStore(specs[s]["store_dir"])
+                        hydrate_farm(farm, shard_store)
+                        farm.attach_store(shard_store)
+                    self.shards.append(farm)
             self._handles = [_InlineShard(f) for f in self.shards]
         # process-backend controller mirrors (see module docstring):
         # quarantine cache, per-doc no-op-patch state, committed-delivery
@@ -517,6 +543,13 @@ class MeshFarm:
 
     # ------------------------------------------------------------------ #
     # routing
+
+    @staticmethod
+    def _shard_store_dir(root: str | None, s: int) -> str | None:
+        """Shard ``s``'s store directory under the mesh ``store_dir`` (None
+        when persistence is off). Deterministic — a new controller over the
+        same root re-adopts every shard's history."""
+        return None if root is None else os.path.join(root, f"shard-{s:03d}")
 
     @staticmethod
     def _blackbox_path(s: int) -> str:
@@ -559,8 +592,12 @@ class MeshFarm:
         processes behind."""
         for h in self._handles:
             h.close()
-            path = getattr(h, "spec", {}).get("blackbox_path") \
-                if not isinstance(h, _InlineShard) else None
+            if isinstance(h, _InlineShard):
+                # final durability barrier; the store DIRECTORY persists
+                if h.farm.store is not None:
+                    h.farm.store.close()
+                continue
+            path = getattr(h, "spec", {}).get("blackbox_path")
             if path:
                 with contextlib.suppress(OSError):
                     os.remove(path)
@@ -810,12 +847,19 @@ class MeshFarm:
     def _recover_worker(self, s: int, in_flight, cause, phase: str):
         """Crash recovery: recover the dead worker's black box into the
         flight timeline and trigger the ``mesh.worker.crash`` dump, then
-        respawn shard `s`'s worker, re-hydrate its committed state by
-        replaying the controller's per-doc delivery log, re-impose
-        surviving quarantines, and quarantine the docs whose delivery was
-        in flight when the worker died (taxonomy: ``WorkerCrashError``,
-        kind "worker_crash"). Returns {global doc: DocOutcome} for the
-        in-flight docs."""
+        respawn shard `s`'s worker, re-hydrate its committed state, and
+        re-impose surviving quarantines; docs whose delivery was in
+        flight when the worker died are quarantined (taxonomy:
+        ``WorkerCrashError``, kind "worker_crash"). Returns {global doc:
+        DocOutcome} for the in-flight docs.
+
+        Re-hydration is two-source: with a mesh ``store_dir``, the
+        respawned worker first recovers every fsynced commit from its
+        shard store during spawn (``_worker_main``); the controller's
+        per-doc delivery-log replay then lands on top — hash-graph dedup
+        makes the overlap a no-op while repairing any group-commit
+        durability window the crash cut off. Without a store, the replay
+        is the only source, exactly as before."""
         h = self._handles[s]
         old_pid = h.pid
         heartbeat_age = h.heartbeat_age()

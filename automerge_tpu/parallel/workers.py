@@ -153,6 +153,18 @@ def _worker_main(conn, spec: dict) -> None:
         page_size=spec["page_size"],
     )
     farm = TpuDocFarm(spec["num_docs"], **farm_args)
+    store = None
+    if spec.get("store_dir"):
+        # per-shard crash-consistent store: opening IS recovery, so a
+        # respawned worker re-hydrates every committed delivery from disk
+        # before the controller's (idempotent) delivery-log replay lands.
+        # The store layer records into this worker's own registry/recorder;
+        # its counters ship home through the same metrics delta.
+        from ..store import ShardStore, hydrate_farm
+
+        store = ShardStore(spec["store_dir"])
+        hydrate_farm(farm, store)
+        farm.attach_store(store)
     if spec.get("warm_buffers"):
         # compile the all-docs-active dispatch shapes into THIS process's
         # jit cache before the readiness barrier lifts, so the measured
@@ -216,6 +228,8 @@ def _worker_main(conn, spec: dict) -> None:
             delta = diff_frames(frame, last_frame)
             last_frame = frame
             conn.send(("err", exc_to_blob(exc), delta, flight.ship()))
+    if store is not None:
+        store.close()  # final durability barrier on clean shutdown
 
 
 def _do_apply(farm, payload, PhaseProfile, use_profile, result_to_wire,

@@ -42,6 +42,11 @@ POINTS = (
     "session.receive",       # before a session frame is decoded (frame=bytes)
     "chaos.send",            # chaos transport, before a frame enters a link
     "chaos.deliver",         # chaos transport, before a frame leaves a link
+    "store.append",          # before a commit frame hits the WAL (doc=int)
+    "store.fsync",           # inside the fsync seam, before fdatasync (path=str)
+    "store.rotate",          # at each rotation stage (stage="footer"|"rename")
+    "store.compact",         # at each compaction stage (stage="write"|"verify"|
+                             #   "swap"|"cleanup")
 )
 
 
@@ -97,6 +102,25 @@ def fail_always(exc_factory=None):
     def hook(**_context):
         raise make()
 
+    return hook
+
+
+def fail_at(n: int, exc_factory=None, stage: str | None = None):
+    """Hook that fails on its `n`-th firing (1-based), counting only
+    firings whose ``stage`` context matches when one is given. The store
+    crash-point sweep walks `n` across every durability boundary of a
+    workload; the hook's ``fired`` attribute reports how many matching
+    firings happened, so the sweep knows when it has walked off the end."""
+    make = exc_factory or (lambda: RuntimeError(f"injected fault at firing {n}"))
+
+    def hook(**context):
+        if stage is not None and context.get("stage") != stage:
+            return
+        hook.fired += 1
+        if hook.fired == n:
+            raise make()
+
+    hook.fired = 0
     return hook
 
 

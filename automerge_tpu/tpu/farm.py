@@ -455,6 +455,10 @@ class TpuDocFarm:
         self.gate_mode = gate_mode
         self.num_docs = num_docs
         self.engine = BatchedMapEngine(num_docs, capacity, page_size=page_size)
+        # optional crash-consistent persistence tier (automerge_tpu/store):
+        # attach_store routes every committed delivery through the WAL and
+        # a group-commit fsync barrier before its patches are acked
+        self.store = None
         # interners are shared across the batch: actor ids, (objectId, key)
         # slots and scalar values are global tables, document state is not.
         # Caps guard the merge-key packing ranges (slot << 44 | ctr << 20 |
@@ -1298,6 +1302,14 @@ class TpuDocFarm:
         snapshots: dict[int, dict] = {}
         fallback_docs: set[int] = set()
         attempted = [d for d in range(self.num_docs) if per_doc_buffers[d]]
+        # WAL capture: remember each attempted doc's committed-change count.
+        # The delta at return is exactly what this call committed — uniform
+        # across the columnar gate, the scalar oracle and the fallback walk,
+        # and naturally zero for docs a quarantine rollback restored.
+        store_marks = (
+            {d: len(self.changes[d]) for d in attempted}
+            if self.store is not None else None
+        )
 
         def quarantine(d, exc):
             """Captures one doc's failure: rolls its state back, drops its
@@ -1709,7 +1721,37 @@ class TpuDocFarm:
                     patch["actor"] = applied_changes[d][0]["actor"]
                     patch["seq"] = applied_changes[d][0]["seq"]
                 patches.append(patch)
+        if self.store is not None:
+            # acked ⇒ durable: commits reach the WAL and the group-commit
+            # fsync barrier BEFORE patches leave this call. A store failure
+            # here raises out of apply_changes — the caller never sees an
+            # ack the log cannot replay.
+            with prof.phase("store_commit"):
+                for d in attempted:
+                    tail = self.changes[d][store_marks[d]:]
+                    if tail:
+                        self.store.append_commit(d, tail)
+                self.store.commit_barrier(self._store_quarantine_snapshot())
         return FarmApplyResult(patches, outcomes)
+
+    # ------------------------------------------------------------------ #
+    # persistence (automerge_tpu/store): the WAL rides the ack boundary
+
+    def attach_store(self, store) -> None:
+        """Attaches a ``ShardStore``: every committed delivery is appended
+        to its WAL and made durable before ``apply_changes`` returns, and
+        quarantine transitions persist to the store's sidecar. Hydrate the
+        farm from the store FIRST (``store.hydrate.open_farm`` does both in
+        order) — attached commits are logged, hydration must not be."""
+        self.store = store
+        # seed the sidecar so pre-existing quarantine state survives even
+        # if no further delivery ever arrives
+        store.save_quarantine(self._store_quarantine_snapshot())
+
+    def _store_quarantine_snapshot(self) -> dict:
+        from ..store.hydrate import quarantine_snapshot
+
+        return quarantine_snapshot(self)
 
     # ------------------------------------------------------------------ #
     # fault domains: snapshot/rollback, quarantine, degraded-mode fallback
@@ -1908,6 +1950,8 @@ class TpuDocFarm:
         _M_Q_ACTIVE.set(len(self.quarantine))
         if released and _FLIGHT.enabled:
             _FLIGHT.record("farm.quarantine.release", docs=released)
+        if released and self.store is not None:
+            self.store.save_quarantine(self._store_quarantine_snapshot())
         return released
 
     # ------------------------------------------------------------------ #

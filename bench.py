@@ -1317,6 +1317,203 @@ def _chaos_main(loss):
     }))
 
 
+def bench_store(num_docs, rounds, ops_per_round, seed=0):
+    """The persistence tier's two costs, measured (`bench.py --store`):
+
+    1. **WAL append overhead** — the e2e merge loop with a `ShardStore`
+       attached (every apply appends checksummed commit frames and pays a
+       group-commit fsync at the ack barrier) vs the same loop bare.
+    2. **Cold-start hydration** — `open_farm`'s batched path (one
+       vectorized `warm_decode_cache` pass + ONE batched `apply_changes`
+       over the whole store) vs the naive per-doc load loop: the same
+       recovered buffers replayed one document at a time through the
+       reference engine (`OpSet.apply_changes` + `get_patch`), which is
+       what cold-starting N documents costs without the farm's batched
+       decode/dispatch — the shape every `load()`-per-doc server does.
+
+    Both cold starts replay the identical on-disk WAL, and both rebuilt
+    farms must match the writer's change log byte-for-byte. Every doc
+    carries its own distinct history (per-doc actor streams) and the
+    decode LRUs are cleared before each timed cold start — a real cold
+    start decodes every chunk, it doesn't inherit a warm process cache."""
+    import shutil
+    import tempfile
+
+    from automerge_tpu.columnar import clear_decode_caches
+    from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+    from automerge_tpu.store import ShardStore, StoreConfig, open_farm
+    from automerge_tpu.tpu.farm import TpuDocFarm
+
+    streams = [
+        _make_change_stream(rounds, ops_per_round, seed=seed + d)
+        for d in range(num_docs)
+    ]
+    deliveries = [
+        [[streams[d][r]] for d in range(num_docs)] for r in range(rounds)
+    ]
+    capacity = rounds * ops_per_round + 8
+    root = tempfile.mkdtemp(prefix="amstore-bench-")
+    wal_root = os.path.join(root, "shard-000")
+    try:
+        # shared warm-up: run the whole stream once on a throwaway farm so
+        # every jit bucket is hot before EITHER timed loop (the bare/WAL
+        # comparison must not hand the second runner a cache the first
+        # paid for)
+        warm = TpuDocFarm(num_docs, capacity=capacity)
+        for delivery in deliveries:
+            warm.apply_changes(delivery)
+        # ...including the whole-history-per-doc bucket the batched
+        # hydration dispatches (a different shape than the round loop)
+        warm_hydrate = TpuDocFarm(num_docs, capacity=capacity)
+        warm_hydrate.apply_changes(
+            [list(streams[d]) for d in range(num_docs)]
+        )
+
+        # -- 1: WAL append overhead -----------------------------------
+        bare = TpuDocFarm(num_docs, capacity=capacity)
+        start = time.perf_counter()
+        for delivery in deliveries:
+            bare.apply_changes(delivery)
+        bare_s = time.perf_counter() - start
+
+        writer = TpuDocFarm(num_docs, capacity=capacity)
+        store = ShardStore(wal_root, StoreConfig())
+        writer.attach_store(store)
+        metrics = get_metrics()
+        metrics.reset()
+        start = time.perf_counter()
+        with enabled_metrics():
+            for delivery in deliveries:
+                writer.apply_changes(delivery)
+        wal_s = time.perf_counter() - start
+        snap = metrics.as_dict()
+        store.close()
+        writer_changes = [list(chs) for chs in writer.changes]
+
+        # -- 2: cold start, per-doc baseline then batched -------------
+        # the baseline is measured on a doc sample and extrapolated
+        # (bench_python precedent) — it is linear in docs by construction
+        from automerge_tpu.opset import OpSet
+
+        reader = ShardStore(wal_root)
+        recovered = sorted(reader.recovered_commits().items())
+        sample = recovered[:min(64, num_docs)]
+        clear_decode_caches()
+        start = time.perf_counter()
+        seq_heads = {}
+        for doc, bufs in sample:
+            opset = OpSet()
+            opset.apply_changes(list(bufs))
+            opset.get_patch()
+            seq_heads[doc] = sorted(opset.heads)
+        sequential_s = (
+            (time.perf_counter() - start) * (num_docs / max(len(sample), 1))
+        )
+        reader.close()
+
+        clear_decode_caches()
+        start = time.perf_counter()
+        hydrated, store2 = open_farm(wal_root, num_docs, capacity=capacity)
+        batched_s = time.perf_counter() - start
+        report = store2.report
+        store2.close()
+
+        total_changes = num_docs * rounds
+        return {
+            "wal": {
+                "bare_s": round(bare_s, 4),
+                "wal_s": round(wal_s, 4),
+                "overhead": round(wal_s / max(bare_s, 1e-9), 3),
+                "append_records": snap.get(
+                    "store.append.records", {}).get("value", 0),
+                "append_bytes": snap.get(
+                    "store.append.bytes", {}).get("value", 0),
+                "fsyncs": snap.get("store.fsyncs", {}).get("value", 0),
+            },
+            "cold_start": {
+                "sequential_s": round(sequential_s, 4),
+                "sequential_sample_docs": len(sample),
+                "batched_s": round(batched_s, 4),
+                "speedup": round(sequential_s / max(batched_s, 1e-9), 2),
+                "docs_per_sec": round(num_docs / max(batched_s, 1e-9)),
+                "sequential_docs_per_sec": round(
+                    num_docs / max(sequential_s, 1e-9)),
+            },
+            "recovery": {
+                "clean": report.clean,
+                "segments": report.segments,
+                "records": report.records,
+                "changes": report.changes,
+                "torn_bytes": report.torn_bytes,
+                "corrupt_segments": len(report.corrupt_segments),
+            },
+            "parity": (
+                [list(chs) for chs in hydrated.changes] == writer_changes
+                and all(
+                    heads == hydrated.heads[d]
+                    for d, heads in seq_heads.items()
+                )
+            ),
+            "recovered_changes": report.changes,
+            "expected_changes": total_changes,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _store_main(quick):
+    """`bench.py --store [--quick]`: one JSON line of persistence-tier
+    figures. Quick mode (the tier-1 smoke shape, `make store`) gates only
+    machine-independent properties: both cold-start paths rebuild the
+    writer's change log byte-for-byte, recovery is clean, and every
+    committed change is accounted for. The full run additionally gates
+    batched hydration >= BENCH_STORE_HYDRATE_FLOOR x the per-doc load
+    loop and writes STORE_r01.json + a perf-ledger row."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if quick:
+        num_docs = int(os.environ.get("BENCH_STORE_DOCS", "24"))
+        rounds = int(os.environ.get("BENCH_STORE_ROUNDS", "4"))
+        ops = int(os.environ.get("BENCH_STORE_OPS", "16"))
+    else:
+        num_docs = int(os.environ.get("BENCH_STORE_DOCS", "256"))
+        rounds = int(os.environ.get("BENCH_STORE_ROUNDS", "6"))
+        ops = int(os.environ.get("BENCH_STORE_OPS", "256"))
+    floor = float(os.environ.get("BENCH_STORE_HYDRATE_FLOOR", "5.0"))
+    result = bench_store(num_docs, rounds, ops)
+    accounted = result["recovered_changes"] == result["expected_changes"]
+    ok = result["parity"] and result["recovery"]["clean"] and accounted
+    if not quick:
+        ok = ok and result["cold_start"]["speedup"] >= floor
+    out = {
+        "metric": "cold-start hydration (batched open_farm vs per-doc loads)",
+        "value": result["cold_start"]["speedup"],
+        "unit": "x speedup",
+        "hydrate_floor": floor if not quick else None,
+        "docs_per_sec": result["cold_start"]["docs_per_sec"],
+        "wal_overhead": result["wal"]["overhead"],
+        "ok": ok,
+        "config": {"docs": num_docs, "rounds": rounds, "ops": ops},
+        **{k: result[k] for k in ("wal", "cold_start", "recovery", "parity")},
+    }
+    print(json.dumps(out))
+    if not quick:
+        _ledger_append({
+            "kind": "store",
+            "config": {"docs": num_docs, "rounds": rounds, "ops": ops},
+            "ops_per_sec": result["cold_start"]["docs_per_sec"],
+            "phases": {"cold_start_batched": result["cold_start"]["batched_s"],
+                       "cold_start_sequential":
+                           result["cold_start"]["sequential_s"],
+                       "wal": result["wal"]["wal_s"],
+                       "bare": result["wal"]["bare_s"]},
+            "ok": ok,
+        })
+        with open(os.path.join(_REPO, "STORE_r01.json"), "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    sys.exit(0 if ok else 1)
+
+
 def bench_python(num_docs, rounds, ops_per_round, seed=0):
     """Sequential reference-parity engine on the same per-doc workload shape
     (measured on a small sample, reported per-op)."""
@@ -1492,6 +1689,8 @@ if __name__ == "__main__":
         _serve_main(quick="--quick" in sys.argv)
     elif "--gate" in sys.argv:
         _gate_main()
+    elif "--store" in sys.argv:
+        _store_main(quick="--quick" in sys.argv)
     elif "--quick" in sys.argv:
         _quick_main()
     elif "--faults" in sys.argv:

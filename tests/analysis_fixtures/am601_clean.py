@@ -1,0 +1,20 @@
+# amlint: durability-plane — fixture: blessed writer + reads stay clean
+import json
+
+from automerge_tpu.store.atomic import atomic_write
+
+
+def save_manifest(path, manifest):
+    """The blessed shape: the atomic writer owns tmp + fsync + rename, so
+    a crash leaves either the old manifest or the new one, never a mix."""
+    atomic_write(path, json.dumps(manifest, sort_keys=True))
+
+
+def load_manifest(path):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def read_segment(path):
+    with open(path, "rb") as fh:
+        return fh.read()

@@ -1,0 +1,10 @@
+# amlint: durability-plane — fixture: justified raw handle silences AM601
+
+
+def open_wal_appender(path):
+    """The one blessed raw handle: the append-only WAL file itself, whose
+    every frame carries length + sha256 so recovery proves the torn
+    boundary without a rename."""
+    # amlint: disable=AM601 — this IS the checksummed appender the rule
+    # points everything else at
+    return open(path, "ab")

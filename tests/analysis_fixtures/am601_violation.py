@@ -1,0 +1,15 @@
+# amlint: durability-plane — fixture: bare writes on the durability plane (AM601)
+import json
+import os
+
+
+def save_manifest(path, manifest):
+    """The forbidden shape: a plain truncate-and-write of a file the
+    recovery scan trusts — a crash mid-write leaves a torn manifest with
+    no checksum to catch it and no rename to anchor the commit point."""
+    with open(path, "w") as fh:
+        fh.write(json.dumps(manifest))
+
+
+def append_record(fd, frame):
+    os.write(fd, frame)

@@ -1,0 +1,545 @@
+"""Tier-1 gate for the crash-consistent persistence tier (automerge_tpu/store).
+
+The store's contract, exercised end to end:
+
+- **Durability at the ack boundary**: every commit a `TpuDocFarm` acked
+  (apply_changes returned) is on disk after any crash; recovery always
+  lands on a clean per-doc *prefix* of the committed history, bit-compatible
+  with the reference wire format.
+- **Torn writes are expected, corruption is quarantined**: a short frame at
+  the active tail truncates non-fatally (`StoreTornWriteError`); a
+  checksum-bad frame or footer-less sealed segment moves the whole segment
+  to `corrupt/` and its docs into the PR-3 quarantine with a
+  `StoreCorruptError` cause — never a crash, never silent loss.
+- **Two-generation compaction**: a crash at ANY stage of
+  rotate()/compact() leaves either the old or the new generation fully
+  live (the crash-point sweep walks an injected failure across every
+  `store.append`/`store.fsync`/`store.rotate`/`store.compact` firing).
+- **Cold start**: `open_farm` hydrates via one batched delivery and
+  restores persisted quarantine state (the save/load regression), and a
+  process-mesh worker SIGKILLed mid-commit re-hydrates from its shard
+  store on respawn and after a full controller cold restart.
+"""
+import json
+import multiprocessing
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _make_change_stream
+
+from automerge_tpu import StoreCorruptError, StoreTornWriteError
+from automerge_tpu.errors import ChecksumError, DecodeError, error_from_kind
+from automerge_tpu.store import (MANIFEST_NAME, QUARANTINE_NAME, ShardStore,
+                                 StoreConfig, atomic_write, open_farm)
+from automerge_tpu.store.wal import CORRUPT_DIR
+from automerge_tpu.testing import faults
+from automerge_tpu.tpu.farm import TpuDocFarm
+
+NUM_DOCS = 4
+ROUNDS = 3
+OPS = 6
+CAP = ROUNDS * OPS + 8
+
+
+def _streams(num_docs=NUM_DOCS, rounds=ROUNDS, seed=0):
+    return [
+        _make_change_stream(rounds, OPS, seed=seed + 31 * d)
+        for d in range(num_docs)
+    ]
+
+
+def _round_delivery(streams, r):
+    return [[streams[d][r]] for d in range(len(streams))]
+
+
+def _write_farm(root, streams, config=None, rounds=None):
+    """A farm with an attached store, the workload committed round by
+    round. Returns (farm, store) still open."""
+    farm = TpuDocFarm(len(streams), capacity=CAP)
+    store = ShardStore(root, config)
+    farm.attach_store(store)
+    for r in range(rounds if rounds is not None else len(streams[0])):
+        farm.apply_changes(_round_delivery(streams, r))
+    return farm, store
+
+
+# ---------------------------------------------------------------------- #
+# round-trip + bit compatibility
+
+
+def test_wal_roundtrip_bit_compatible(tmp_path):
+    """Reopening replays the WAL into a farm whose change log is
+    byte-identical to the writer's — the persisted chunks ARE the
+    reference-format buffers that were applied."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm, store = _write_farm(root, streams)
+    store.close()
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert store2.report.clean, vars(store2.report)
+    assert [list(c) for c in farm2.changes] == [list(c) for c in farm.changes]
+    assert farm2.heads == farm.heads
+    assert farm2.quarantine == {}
+    for d in range(NUM_DOCS):
+        assert json.dumps(farm2.get_patch(d), sort_keys=True) == \
+            json.dumps(farm.get_patch(d), sort_keys=True)
+    store2.close()
+
+
+def test_rotation_and_compaction_roundtrip(tmp_path):
+    """rotate() seals the active segment (footer + rename), compact()
+    folds sealed WAL into a verified cold generation and deletes the
+    sources; the reopened farm is unchanged through both."""
+    root = str(tmp_path / "shard")
+    streams = _streams(rounds=ROUNDS + 2)
+    farm = TpuDocFarm(NUM_DOCS, capacity=CAP + 2 * OPS)
+    store = ShardStore(root)
+    farm.attach_store(store)
+    for r in range(ROUNDS):
+        farm.apply_changes(_round_delivery(streams, r))
+    store.rotate()
+    for r in range(ROUNDS, ROUNDS + 2):
+        farm.apply_changes(_round_delivery(streams, r))
+    store.compact()
+    names = set(os.listdir(root))
+    assert MANIFEST_NAME in names
+    assert any(n.startswith("cold-") for n in names)
+    assert not any(n.endswith(".seg") and n.startswith("wal-") for n in names)
+    store.close()
+
+    farm2, store2 = open_farm(
+        root, NUM_DOCS, capacity=CAP + 2 * OPS)
+    assert store2.report.clean
+    assert [list(c) for c in farm2.changes] == [list(c) for c in farm.changes]
+    assert farm2.heads == farm.heads
+    store2.close()
+
+
+# ---------------------------------------------------------------------- #
+# torn writes and corruption
+
+
+def test_torn_tail_truncates_to_last_whole_frame(tmp_path):
+    """A partial frame at the active tail (the power-loss signature) is
+    truncated away; every acked commit before it survives."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm, store = _write_farm(root, streams)
+    store.close()
+    active = [n for n in os.listdir(root) if n.endswith(".open")]
+    assert len(active) == 1
+    path = os.path.join(root, active[0])
+    with open(path, "ab") as fh:
+        fh.write(b"\x99\x00\x00\x00" + b"torn!")  # length says 153, body 5
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert not store2.report.clean
+    assert store2.report.torn_bytes == 9
+    assert store2.report.corrupt_segments == []
+    assert [list(c) for c in farm2.changes] == [list(c) for c in farm.changes]
+    store2.close()
+
+    # and the truncated file appends cleanly again
+    farm3, store3 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert store3.report.clean
+    store3.close()
+
+
+def test_torn_mid_frame_recovers_strict_prefix(tmp_path):
+    """Chopping bytes off the active tail loses exactly the last frames,
+    never garbles the ones before them."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm, store = _write_farm(root, streams)
+    store.close()
+    active = [n for n in os.listdir(root) if n.endswith(".open")]
+    path = os.path.join(root, active[0])
+    os.truncate(path, os.path.getsize(path) - 11)
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert store2.report.torn_bytes > 0
+    total = sum(len(c) for c in farm2.changes)
+    full = sum(len(c) for c in farm.changes)
+    assert 0 < total < full
+    for d in range(NUM_DOCS):
+        k = len(farm2.changes[d])
+        assert list(farm2.changes[d]) == list(farm.changes[d])[:k]
+    store2.close()
+
+
+def test_corrupt_segment_quarantines_only_its_docs(tmp_path):
+    """A checksum-bad frame condemns its whole segment: the file moves to
+    corrupt/, its docs enter quarantine with a StoreCorruptError cause,
+    and docs whose history lives in OTHER segments hydrate untouched."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm = TpuDocFarm(NUM_DOCS, capacity=CAP)
+    store = ShardStore(root)
+    farm.attach_store(store)
+    # segment 1: docs 0..1 only, sealed; segment 2: docs 2..3
+    for r in range(ROUNDS):
+        farm.apply_changes(
+            [[streams[d][r]] if d < 2 else [] for d in range(NUM_DOCS)])
+    store.rotate()
+    for r in range(ROUNDS):
+        farm.apply_changes(
+            [[streams[d][r]] if d >= 2 else [] for d in range(NUM_DOCS)])
+    store.close()
+
+    sealed = [n for n in os.listdir(root) if n.endswith(".seg")]
+    assert len(sealed) == 1
+    path = os.path.join(root, sealed[0])
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0x40  # mid-payload bit flip
+    with open(path, "wb") as fh:
+        fh.write(data)
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert not store2.report.clean
+    assert sealed[0] in store2.report.corrupt_segments
+    assert os.path.exists(os.path.join(root, CORRUPT_DIR, sealed[0]))
+    assert set(farm2.quarantine) == {0, 1}
+    for exc in farm2.quarantine.values():
+        assert isinstance(exc, StoreCorruptError)
+    # the untouched segment's docs hydrated fully
+    for d in (2, 3):
+        assert list(farm2.changes[d]) == list(farm.changes[d])
+    store2.close()
+
+
+def test_store_errors_are_decode_taxonomy(tmp_path):
+    """Satellite: the store's failure modes are classifiable taxonomy
+    errors, exported from the package root and rebuildable by kind."""
+    import automerge_tpu
+
+    assert automerge_tpu.StoreCorruptError is StoreCorruptError
+    assert automerge_tpu.StoreTornWriteError is StoreTornWriteError
+    assert issubclass(StoreCorruptError, DecodeError)
+    assert issubclass(StoreTornWriteError, DecodeError)
+    assert StoreCorruptError.kind == "store_corrupt"
+    assert StoreTornWriteError.kind == "store_torn"
+    rebuilt = error_from_kind("store_corrupt", "boom")
+    assert isinstance(rebuilt, StoreCorruptError)
+    assert str(rebuilt) == "boom"
+
+
+# ---------------------------------------------------------------------- #
+# group commit + the atomic writer
+
+
+def test_group_commit_defers_fsync_not_consistency(tmp_path):
+    """group_commit=N pays one fsync every N barriers (the documented
+    durability window); the WAL content is flushed and prefix-consistent
+    either way."""
+    fsyncs = []
+
+    def counter(**ctx):
+        # only count syncs of the active WAL segment — the quarantine
+        # sidecar's atomic_write fires the same point for its own file
+        if ".open" in (ctx.get("path") or ""):
+            fsyncs.append(ctx["path"])
+
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    with faults.inject("store.fsync", counter):
+        farm = TpuDocFarm(NUM_DOCS, capacity=CAP)
+        store = ShardStore(root, StoreConfig(group_commit=3))
+        farm.attach_store(store)
+        barrier_syncs = []
+        for r in range(ROUNDS):
+            before = len(fsyncs)
+            farm.apply_changes(_round_delivery(streams, r))
+            barrier_syncs.append(len(fsyncs) - before)
+    # barriers 1 and 2 deferred, barrier 3 paid the fsync
+    assert barrier_syncs == [0, 0, 1]
+    store.close()
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert [list(c) for c in farm2.changes] == [list(c) for c in farm.changes]
+    store2.close()
+
+
+def test_atomic_write_leaves_old_content_on_fsync_crash(tmp_path):
+    """Satellite: the shared atomic writer (store manifests/sidecars AND
+    the obs black box) is all-or-nothing — a crash in its fsync seam
+    leaves the previous content untouched and no tmp litter."""
+    path = str(tmp_path / "MANIFEST.json")
+    atomic_write(path, '{"generation": 1}')
+    with faults.inject("store.fsync", faults.fail_always(
+            lambda: OSError("injected fsync failure"))):
+        with pytest.raises(OSError):
+            atomic_write(path, '{"generation": 2}')
+    assert open(path).read() == '{"generation": 1}'
+    assert os.listdir(tmp_path) == ["MANIFEST.json"]
+
+
+def test_blackbox_rides_the_atomic_writer(tmp_path):
+    """Satellite: obs/flight.py's black box goes through the shared
+    atomic_write (tmp + rename), so a reader never observes a
+    half-written file and no tmp litter survives."""
+    from automerge_tpu.obs.flight import (FlightRecorder, read_blackbox,
+                                          write_blackbox)
+
+    rec = FlightRecorder(capacity=8)
+    rec.enabled = True
+    rec.record("mesh.worker.spawn", shard=0, pid=1)
+    path = str(tmp_path / "bb.json")
+    write_blackbox(path, rec)
+    payload = read_blackbox(path)
+    assert payload is not None
+    assert payload["events"][-1]["event"] == "mesh.worker.spawn"
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+
+# ---------------------------------------------------------------------- #
+# quarantine state survives save/load (the satellite regression)
+
+
+def test_quarantine_state_survives_cold_restart(tmp_path):
+    """The PR-bugfix regression: a quarantined doc's cause and failure
+    counts were silently reset by save/load. Now the sidecar persists
+    them through the barrier and hydration restores them."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm = TpuDocFarm(NUM_DOCS, capacity=CAP, quarantine_threshold=1)
+    store = ShardStore(root)
+    farm.attach_store(store)
+    farm.apply_changes(_round_delivery(streams, 0))
+    # poison doc 1 into organic quarantine (checksum damage)
+    delivery = [[] for _ in range(NUM_DOCS)]
+    delivery[1] = [faults.bit_flipped(streams[1][1])]
+    res = farm.apply_changes(delivery)
+    assert res.outcomes[1].status == "quarantined"
+    assert 1 in farm.quarantine
+    counts = list(farm.fault_counts)
+    store.close()
+
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP,
+                              quarantine_threshold=1)
+    assert 1 in farm2.quarantine
+    assert isinstance(farm2.quarantine[1], ChecksumError)
+    assert list(farm2.fault_counts) == counts
+    # released docs stay released across the NEXT cold restart
+    assert farm2.release_quarantine(1) == [1]
+    store2.close()
+    farm3, store3 = open_farm(root, NUM_DOCS, capacity=CAP,
+                              quarantine_threshold=1)
+    assert farm3.quarantine == {}
+    # and the released doc accepts redelivery of the clean change
+    delivery = [[] for _ in range(NUM_DOCS)]
+    delivery[1] = [streams[1][1]]
+    res = farm3.apply_changes(delivery)
+    assert res.outcomes[1].status == "applied"
+    store3.close()
+
+
+def test_quarantine_sidecar_is_advisory(tmp_path):
+    """An unreadable sidecar degrades to 'no persisted quarantine', never
+    a failed open."""
+    root = str(tmp_path / "shard")
+    streams = _streams()
+    farm, store = _write_farm(root, streams)
+    store.close()
+    with open(os.path.join(root, QUARANTINE_NAME), "w") as fh:
+        fh.write("not json {")
+    farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+    assert farm2.quarantine == {}
+    store2.close()
+
+
+# ---------------------------------------------------------------------- #
+# the crash-point sweep
+
+
+def _crash_workload(root, streams, point, n):
+    """One scripted run with fail_at(n) armed at `point`: ROUNDS commits,
+    then a rotation, then a compaction. Returns (acked_rounds, hook,
+    refs) where refs pins the abandoned farm/store so their buffered
+    handles stay un-flushed (the in-process stand-in for a killed
+    process) until the caller's reopen has happened."""
+    hook = faults.fail_at(n, lambda: OSError(f"injected crash at {point}#{n}"))
+    farm = store = None
+    acked = 0
+    try:
+        with faults.inject(point, hook):
+            farm = TpuDocFarm(len(streams), capacity=CAP)
+            store = ShardStore(root, StoreConfig())
+            farm.attach_store(store)
+            for r in range(ROUNDS):
+                farm.apply_changes(_round_delivery(streams, r))
+                acked = r + 1
+            store.rotate()
+            store.compact()
+            store.close()
+            store = None
+    except OSError:
+        pass
+    return acked, hook, (farm, store)
+
+
+@pytest.mark.parametrize(
+    "point", ["store.append", "store.fsync", "store.rotate", "store.compact"])
+def test_crash_point_sweep(tmp_path, point):
+    """Walks an injected crash across EVERY firing of one durability
+    boundary over a commit+rotate+compact workload. After each crash the
+    reopened farm must hold, per doc, an exact prefix of the intended
+    history that covers every acked commit, with no corrupt segments —
+    the store never trades consistency for the crash, only the unacked
+    tail."""
+    streams = _streams()
+    n = 1
+    while True:
+        root = str(tmp_path / f"{point.replace('.', '-')}-{n}")
+        acked, hook, refs = _crash_workload(root, streams, point, n)
+        if hook.fired < n:
+            # walked off the end: the whole workload ran fault-free
+            assert acked == ROUNDS
+            break
+        farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+        assert store2.report.corrupt_segments == [], (point, n)
+        for d in range(NUM_DOCS):
+            got = list(farm2.changes[d])
+            assert got == list(streams[d])[:len(got)], (point, n, d)
+            assert len(got) >= acked, (point, n, d, acked)
+        assert farm2.quarantine == {}, (point, n)
+        store2.close()
+        del refs
+        n += 1
+    assert n > 1, f"{point} never fired"
+
+
+def test_compact_crash_leaves_one_generation_live(tmp_path):
+    """Pin the two-generation invariant at each named compaction stage:
+    whatever stage dies, reopening serves the complete history exactly
+    once."""
+    streams = _streams()
+    for stage in ("write", "verify", "swap", "cleanup"):
+        root = str(tmp_path / stage)
+        farm, store = _write_farm(root, streams)
+        store.rotate()
+        hook = faults.fail_at(1, lambda: OSError("injected"), stage=stage)
+        with faults.inject("store.compact", hook):
+            with pytest.raises(OSError):
+                store.compact()
+        assert hook.fired == 1, stage
+        store.close()
+        farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+        assert store2.report.clean, (stage, vars(store2.report))
+        assert [list(c) for c in farm2.changes] == \
+            [list(c) for c in farm.changes], stage
+        store2.close()
+
+
+def test_rotate_crash_recovery_finishes_or_resumes(tmp_path):
+    """A crash between the footer write and the rename leaves a footer-
+    stamped .open file; recovery finishes the seal instead of calling it
+    corrupt. A crash before the footer leaves the segment active."""
+    streams = _streams()
+    for stage in ("footer", "rename"):
+        root = str(tmp_path / stage)
+        farm, store = _write_farm(root, streams)
+        hook = faults.fail_at(1, lambda: OSError("injected"), stage=stage)
+        with faults.inject("store.rotate", hook):
+            with pytest.raises(OSError):
+                store.rotate()
+        store.close()
+        farm2, store2 = open_farm(root, NUM_DOCS, capacity=CAP)
+        assert store2.report.corrupt_segments == [], stage
+        assert [list(c) for c in farm2.changes] == \
+            [list(c) for c in farm.changes], stage
+        if stage == "rename":
+            # footer made it down: recovery completed the rotation
+            assert store2.report.sealed_on_open >= 1
+        store2.close()
+
+
+# ---------------------------------------------------------------------- #
+# the process mesh: SIGKILL mid-commit + controller cold restart
+
+
+@pytest.mark.skipif(sys.platform == "win32", reason="POSIX process mesh")
+def test_mesh_worker_sigkill_mid_commit_then_cold_restart(tmp_path):
+    """The acceptance crash: a shard worker SIGKILLs itself mid-delivery.
+    The controller quarantines the in-flight docs, the respawned worker
+    re-hydrates from its shard store (plus the delivery-log replay), a
+    release+redelivery completes the round — and a brand-new MeshFarm
+    over the same store_dir serves identical patches after close()."""
+    from automerge_tpu.parallel.meshfarm import MeshFarm
+
+    store_dir = str(tmp_path / "mesh-store")
+    num_docs, rounds = 6, 2
+    streams = _streams(num_docs=num_docs, rounds=rounds + 1, seed=100)
+    mesh = MeshFarm(num_docs, num_shards=2, capacity=CAP,
+                    mesh_backend="process", store_dir=store_dir)
+    try:
+        for r in range(rounds):
+            mesh.apply_changes(_round_delivery(streams, r))
+
+        mesh.inject_worker_fault(1, when="next_apply")
+        res = mesh.apply_changes(_round_delivery(streams, rounds))
+        crashed = [d for d in range(num_docs)
+                   if res.outcomes[d].status == "quarantined"]
+        assert crashed, "the SIGKILL round should quarantine in-flight docs"
+        for d in crashed:
+            mesh.release_quarantine(d)
+        delivery = [[] for _ in range(num_docs)]
+        for d in crashed:
+            delivery[d] = [streams[d][rounds]]
+        res = mesh.apply_changes(delivery)
+        assert all(res.outcomes[d].status == "applied" for d in crashed)
+        before = [json.dumps(mesh.get_patch(d), sort_keys=True)
+                  for d in range(num_docs)]
+    finally:
+        mesh.close()
+    assert multiprocessing.active_children() == []
+
+    cold = MeshFarm(num_docs, num_shards=2, capacity=CAP,
+                    mesh_backend="process", store_dir=store_dir)
+    try:
+        after = [json.dumps(cold.get_patch(d), sort_keys=True)
+                 for d in range(num_docs)]
+        assert after == before
+    finally:
+        cold.close()
+
+
+def test_mesh_store_dir_vs_rebalance_is_an_error(tmp_path):
+    from automerge_tpu.parallel.meshfarm import MeshFarm
+
+    with pytest.raises(ValueError, match="rebalanc"):
+        MeshFarm(4, num_shards=2, store_dir=str(tmp_path / "s"),
+                 rebalance_interval=2)
+
+
+def test_mesh_inline_backend_persists_too(tmp_path):
+    """store_dir is backend-agnostic: the inline mesh writes the same
+    per-shard stores and cold-restarts from them."""
+    from automerge_tpu.parallel.meshfarm import MeshFarm
+
+    store_dir = str(tmp_path / "mesh-store")
+    num_docs = 6
+    streams = _streams(num_docs=num_docs, seed=200)
+    mesh = MeshFarm(num_docs, num_shards=2, capacity=CAP,
+                    mesh_backend="inline", store_dir=store_dir)
+    try:
+        for r in range(ROUNDS):
+            mesh.apply_changes(_round_delivery(streams, r))
+        before = [json.dumps(mesh.get_patch(d), sort_keys=True)
+                  for d in range(num_docs)]
+    finally:
+        mesh.close()
+    assert sorted(os.listdir(store_dir)) == ["shard-000", "shard-001"]
+
+    cold = MeshFarm(num_docs, num_shards=2, capacity=CAP,
+                    mesh_backend="inline", store_dir=store_dir)
+    try:
+        after = [json.dumps(cold.get_patch(d), sort_keys=True)
+                 for d in range(num_docs)]
+        assert after == before
+    finally:
+        cold.close()

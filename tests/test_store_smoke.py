@@ -1,0 +1,89 @@
+"""Tier-1 smoke gate for the persistence tier (README "Persistence"),
+mirroring the bench-smoke pattern: one `bench.py --store --quick` run
+(the `make store` target) gated on machine-independent properties:
+
+- the WAL-attached farm and the farm rebuilt from the on-disk log are
+  byte-identical (change-log parity + heads + patches — the `parity`
+  bit covers all three in the bench);
+- the recovery report is clean: no torn bytes, no corrupt segments;
+- full change accounting: every committed change is recovered (the WAL
+  appended exactly docs x rounds records and the reopened store replays
+  every one — no dryrun path can satisfy this);
+- the group-commit policy actually fsynced (one barrier per round in
+  quick mode's group_commit=1 config).
+
+The >= 5x batched-hydration floor is a *full-run* gate (`bench.py
+--store`, STORE_r01.json) — wall-clock ratios on a loaded CI host are
+not machine-independent, so the quick twin only checks the honesty
+invariants the speedup measurement rests on.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RESULT = None
+
+
+def _smoke():
+    global _RESULT
+    if _RESULT is None:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py"),
+             "--store", "--quick"],
+            cwd=_REPO, capture_output=True, text=True, timeout=300,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+        assert lines, (proc.stdout[-2000:], proc.stderr[-2000:])
+        result = json.loads(lines[-1])
+        assert proc.returncode == 0, (result, proc.stderr[-2000:])
+        _RESULT = result
+    return _RESULT
+
+
+def test_quick_gate_passes():
+    result = _smoke()
+    assert result["ok"], result
+
+
+def test_hydrated_farm_is_bit_compatible():
+    """The reopened farm's change log, heads and patches match the
+    writer's — the persisted chunks are the reference-format buffers."""
+    result = _smoke()
+    assert result["parity"] is True, result
+
+
+def test_recovery_report_is_clean():
+    result = _smoke()
+    rec = result["recovery"]
+    assert rec["clean"] is True, rec
+    assert rec["torn_bytes"] == 0, rec
+    assert rec["corrupt_segments"] == 0, rec
+
+
+def test_every_committed_change_is_accounted_for():
+    """docs x rounds changes went through the WAL and every one came
+    back on replay — the durability claim is end-to-end, not sampled."""
+    result = _smoke()
+    cfg = result["config"]
+    expected = cfg["docs"] * cfg["rounds"]
+    assert result["wal"]["append_records"] == expected, result
+    assert result["recovery"]["records"] == expected, result
+    assert result["recovery"]["changes"] == expected, result
+
+
+def test_group_commit_fsynced_each_barrier():
+    """Quick mode runs group_commit=1: one kernel fsync per apply round,
+    proving the ack boundary actually reaches the durability seam."""
+    result = _smoke()
+    assert result["wal"]["fsyncs"] == result["config"]["rounds"], result
+
+
+def test_wal_overhead_is_reported():
+    """The WAL-attached run reports a finite overhead ratio vs the bare
+    farm (the number the README's group-commit guidance is based on)."""
+    result = _smoke()
+    assert result["wal"]["overhead"] > 0, result
+    assert result["wal"]["append_bytes"] > 0, result
