@@ -9,6 +9,13 @@ PY ?= python
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
 
+# incremental lint: files changed vs REF (default HEAD) plus their
+# transitive importers; falls back to the full scan when a rule-scoped
+# module (workers/meshfarm/serve) imports a changed one
+REF ?= HEAD
+lint-changed:
+	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis --changed $(REF) automerge_tpu
+
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
 

@@ -16,6 +16,22 @@ CLI::
 
     python -m automerge_tpu.analysis [paths...]      # exit 1 on findings
     python -m automerge_tpu.analysis --list-rules
+    python -m automerge_tpu.analysis --select AM403,AM701
+    python -m automerge_tpu.analysis --changed HEAD~1   # incremental
+    python -m automerge_tpu.analysis --json
+
+Exit codes are pinned: 0 = clean, 1 = unsuppressed findings, 2 = usage
+error (unknown rule id in ``--select`` or an ``# amlint: disable=``
+directive, unreadable path, bad ``--changed`` ref) — usage errors print
+one line to stderr, never a traceback.
+
+Every scan builds a whole-program :class:`graph.CallGraph` over the file
+set and hands it to every rule family, so the reachability rules (AM2xx
+tracer taint, AM303 recording-in-traced-code, AM403 blocking-in-serve,
+AM502/AM305 worker import hygiene) are *transitive*: they follow calls
+and imports across files — from-imports, module aliases, inferable
+method receivers — with bounded depth, and print the discovery chain
+(``[reachable via a -> b -> c]``) in every diagnostic.
 
 Rule families (see core.RULES for the catalog):
 
@@ -50,7 +66,11 @@ Rule families (see core.RULES for the catalog):
   comprehensions keep per-delivery Python O(active), not O(farm)
   (AM501); worker-executed modules importing the controller layer or
   touching process-global registry accessors — workers speak the pipe
-  protocol and ship metric deltas explicitly (AM502).
+  protocol and ship metric deltas explicitly (AM502); controller/worker
+  pipe-frame drift — ops sent with no handler, dead handlers, wrong
+  request/response tuple arity, response fields read that nothing
+  writes (AM503, modules ``workers``/``meshfarm`` plus files marked
+  ``# amlint: pipe-protocol``).
 - **AM6xx durability**: bare write-mode ``open()``/``os.write`` in
   durability-plane modules (``store/`` stems or files marked
   ``# amlint: durability-plane``) — durable bytes flow only through
@@ -58,6 +78,13 @@ Rule families (see core.RULES for the catalog):
   checksummed appender, so crash recovery can prove exactly what
   committed; the two primitives themselves carry justified suppressions
   (AM601).
+- **AM7xx shape stability**: ``profiled_jit``/``jax.jit`` dispatch sites
+  fed an array whose shape derives from an unbucketed dynamic length —
+  no pow2/bucket helper on the dataflow path from ``len()``/``.shape``/
+  a dynamic slice to the dispatch. The static twin of amprof's runtime
+  ``prof.recompile.storm`` detector: it reports the storm before the
+  compile time is burned, with the dataflow chain in the diagnostic
+  (AM701).
 
 Suppression: ``# amlint: disable=AM102`` trailing a line or standing alone
 on the line above; ``# amlint: disable-file=AM203`` for a whole file.
@@ -71,16 +98,25 @@ import tokenize
 from pathlib import Path
 
 from . import (boundary, catalog, durability, hotpath, meshrules, obsrules,
-               packing, profrules, taxonomy, tracer, workerrules)
-from .core import RULES, FileContext, Finding, collect_files
+               packing, profrules, protorules, shaperules, taxonomy, tracer,
+               workerrules)
+from .core import RULES, FileContext, Finding, UsageError, collect_files
+from .graph import CallGraph
 
 __all__ = [
     "RULES",
     "Finding",
+    "UsageError",
+    "CallGraph",
     "run_analysis",
     "format_report",
     "default_target",
 ]
+
+#: every rule family, in report order — each exposes check(ctxs, graph)
+FAMILIES = (packing, tracer, boundary, obsrules, catalog, taxonomy,
+            hotpath, meshrules, workerrules, profrules, durability,
+            shaperules, protorules)
 
 
 def default_target() -> Path:
@@ -94,18 +130,31 @@ def run_analysis(paths, include_suppressed: bool = False) -> list[Finding]:
     Returns findings sorted by (path, line, rule). Suppressed findings are
     dropped unless ``include_suppressed`` is set (they then carry
     ``suppressed=True``). Unparseable files yield an AM000 finding instead
-    of raising."""
+    of raising. A suppression directive naming an unknown rule id raises
+    :class:`UsageError` — a typo'd ``disable=`` silently un-suppresses,
+    which is worse than failing loudly."""
     ctxs: list[FileContext] = []
     findings: list[Finding] = []
+    for p in paths:
+        if not Path(p).exists():
+            raise UsageError(f"no such file or directory: {p}")
     for path, display in collect_files([Path(p) for p in paths]):
         try:
             ctxs.append(FileContext(path, display))
         except (SyntaxError, UnicodeDecodeError, tokenize.TokenError) as exc:
             findings.append(Finding("AM000", display, getattr(exc, "lineno", 1) or 1,
                                     0, f"could not parse: {exc}"))
-    for family in (packing, tracer, boundary, obsrules, catalog, taxonomy,
-                   hotpath, meshrules, workerrules, profrules, durability):
-        findings.extend(family.check(ctxs))
+        except OSError as exc:
+            raise UsageError(f"cannot read {display}: {exc}") from exc
+    for ctx in ctxs:
+        for line, rid in ctx.unknown_suppressions:
+            raise UsageError(
+                f"{ctx.display}:{line}: unknown rule id {rid!r} in "
+                f"suppression directive (see --list-rules)"
+            )
+    graph = CallGraph(ctxs)
+    for family in FAMILIES:
+        findings.extend(family.check(ctxs, graph))
     findings.sort(key=lambda f: (f.path, f.line, f.rule_id, f.col))
     if not include_suppressed:
         findings = [f for f in findings if not f.suppressed]

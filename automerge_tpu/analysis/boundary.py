@@ -145,7 +145,7 @@ def _check_device_phases(ctx: FileContext) -> list[Finding]:
     return findings
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         findings += _check_imports(ctx)

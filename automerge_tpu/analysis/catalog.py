@@ -144,7 +144,7 @@ def _collect(ctx: FileContext) -> tuple[list[tuple[str, ast.AST]], set[str]]:
 
 # ---------------------------------------------------------------------- #
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     all_literals: set[str] = set()
     all_fragments: set[str] = set()

@@ -98,17 +98,37 @@ RULES: dict[str, tuple[str, str]] = {
                       "registry accessor (get_metrics/get_flight/...) — "
                       "workers speak the pipe protocol and record into "
                       "explicitly shipped sinks"),
+    "AM503": ("protocol", "controller/worker pipe frames drift: an op is "
+                          "sent without a worker handler (or handled but "
+                          "never sent), a response/request tuple is built "
+                          "or unpacked at the wrong arity (responses are "
+                          "(status, payload, metrics_delta, flight_events) "
+                          "4-tuples, requests (op, payload) 2-tuples), or "
+                          "a response field is read that no worker-side "
+                          "producer writes"),
     "AM601": ("store", "bare write-mode open()/os.write in a durability-"
                        "plane module (store/ or `# amlint: durability-"
                        "plane`) — durable bytes go through "
                        "store.atomic.atomic_write or the WAL's checksummed "
                        "appender so recovery can prove the commit point; "
                        "justify raw handles with a suppression"),
+    "AM701": ("shape", "jit dispatch whose array-shape argument derives "
+                       "from an unbucketed dynamic length (len()/.shape/"
+                       "dynamic slice with no pow2/bucket helper on the "
+                       "dataflow path) — the static twin of amprof's "
+                       "prof.recompile.storm: every new length costs a "
+                       "fresh XLA compile"),
 }
 
 _SUPPRESS_RE = re.compile(
     r"#\s*amlint:\s*(disable|disable-file)\s*=\s*([A-Z0-9,\s]+)"
 )
+
+
+class UsageError(Exception):
+    """Operator error (unknown rule id, unreadable path): the CLI prints
+    one line and exits 2 — never a traceback, never conflated with the
+    exit-1 'findings exist' outcome."""
 _HOST_ONLY_RE = re.compile(r"#\s*amlint:\s*host-only")
 _HOT_PATH_RE = re.compile(r"#\s*amlint:\s*hot-path")
 #: justified observatory bypass: suppresses AM306 on its line (trailing)
@@ -148,6 +168,10 @@ class FileContext:
         self.file_suppress: set[str] = set()
         self.host_only_marker = False
         self.hot_path_marker = False
+        #: (line, id) pairs for disable directives naming ids not in RULES
+        #: — a typo'd suppression silently un-suppresses, so the CLI treats
+        #: these as usage errors (exit 2)
+        self.unknown_suppressions: list[tuple[int, str]] = []
         self._parse_comments()
 
     # ------------------------------------------------------------------ #
@@ -184,6 +208,9 @@ class FileContext:
             if m:
                 ids = {p.strip() for p in m.group(2).split(",") if p.strip()}
                 kind = m.group(1)
+                for rid in sorted(ids):
+                    if rid not in RULES:
+                        self.unknown_suppressions.append((line, rid))
             if _UNPROFILED_JIT_RE.search(text):
                 ids.add("AM306")
                 kind = kind or "disable"

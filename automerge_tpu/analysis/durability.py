@@ -70,7 +70,7 @@ def _open_mode(node: ast.Call):
     return ...
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         if not _in_scope(ctx):

@@ -214,7 +214,7 @@ def _check_byte_loops(ctx: FileContext, findings: list) -> None:
             ))
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         if _in_decode_scope(ctx):

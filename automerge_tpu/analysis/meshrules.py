@@ -69,7 +69,7 @@ def _subscripts_by(body, var: str) -> bool:
     return False
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         if not _in_scope(ctx):

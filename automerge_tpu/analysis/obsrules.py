@@ -10,10 +10,14 @@ host wrappers around a dispatch, never inside it.
 The rule reuses the AM20x taint walker's trace-root discovery
 (tracer._ModuleChecker: jit-like decorators with static_argnums honoured,
 functions referenced as combinator arguments, nested defs handed to
-``jax.vmap``/``pl.pallas_call``/...) and extends it with a plain
-reachability pass: from every traced root, direct calls into module-level
-and nested functions are followed, so a helper called from a jitted entry
-point is checked too.
+``jax.vmap``/``pl.pallas_call``/...) and extends it with a *transitive*
+reachability pass over the whole scan: from every traced root, calls into
+module-level and nested functions are followed, and calls the call graph
+(graph.py) resolves across files — from-imported helpers, module-alias
+attributes, same-scan class methods — are followed into their home
+modules too, with a bounded depth. Every diagnostic prints the discovery
+chain (``[reachable via root -> helper -> ...]``) so a finding three
+frames below the jit entry point is still actionable.
 
 Flagged inside jit/vmap/Pallas-reachable code:
 
@@ -31,7 +35,7 @@ from __future__ import annotations
 import ast
 
 from .core import FileContext, Finding, dotted_name
-from .tracer import _ModuleChecker
+from .tracer import _Coordinator, _ModuleChecker
 
 _RECORD_ATTRS = {"inc", "observe", "span", "phase", "record"}
 _OBS_MODULE_HINTS = {"obs", "metrics", "spans", "profiling"}
@@ -62,18 +66,22 @@ class _ObsChecker(_ModuleChecker):
     reachability (taint is irrelevant here — a recording call is wrong in
     traced code whatever its arguments)."""
 
-    def __init__(self, ctx: FileContext):
-        super().__init__(ctx)
+    def __init__(self, ctx: FileContext, coordinator=None):
+        super().__init__(ctx, coordinator)
         self.obs_aliases = _obs_aliases(ctx.tree)
 
-    def _analyze_function(self, fn, tainted, worklist) -> None:
+    def _analyze_function(self, fn, tainted, chain) -> None:
+        self._current_chain = chain
         nested = {
             n.name: n
             for n in ast.walk(fn)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
             and n is not fn
         }
-        for node in ast.walk(fn):
+        # walk the BODY only: decorator expressions run at def time on the
+        # host, so `@profiled_jit(...)` must not drag the registration
+        # helper into "traced code"
+        for node in (n for stmt in fn.body for n in ast.walk(stmt)):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if not isinstance(node, ast.Call):
@@ -99,18 +107,23 @@ class _ObsChecker(_ModuleChecker):
                     "code runs once at trace time — record on the host "
                     "around the dispatch",
                 )
-            # reachability: follow direct calls into sibling functions
+            # transitive reachability: same-module calls directly, anything
+            # else (from-imports, aliases, methods) through the call graph
             callee = None
             if isinstance(node.func, ast.Name):
                 callee = nested.get(node.func.id) or self.module_funcs.get(
                     node.func.id
                 )
             if callee is not None and callee is not fn:
-                worklist.append((callee, frozenset()))
+                self.coordinator.enqueue(
+                    self, callee, frozenset(), chain + (callee.name,)
+                )
+            elif callee is None:
+                cross = self.resolve_cross(node)
+                if cross is not None and cross.node is not fn:
+                    self.coordinator.enqueue_info(cross, frozenset(), chain)
+        self._current_chain = ()
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
-    findings: list[Finding] = []
-    for ctx in ctxs:
-        findings += _ObsChecker(ctx).run()
-    return findings
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
+    return _Coordinator(ctxs, graph, checker_cls=_ObsChecker).run()

@@ -75,7 +75,7 @@ def _imports_canonical_name(ctx: FileContext) -> bool:
     return False
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     per_file = {ctx: _file_groups(ctx) for ctx in ctxs}
     layout = _canonical_layout(per_file)
     findings: list[Finding] = []

@@ -44,7 +44,7 @@ def _exempt(node: ast.AST) -> bool:
     return False
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         for node in ast.walk(ctx.tree):

@@ -41,6 +41,15 @@ device readbacks (``jax.device_get``/``block_until_ready`` — the batcher's
 single flush dispatch is the only place device latency may be paid, with a
 justified suppression) are all banned in serve modules (any file under a
 ``serve/`` directory, plus files marked ``# amlint: serve-event-loop``).
+
+AM403 is *transitively* enforced: beyond the direct per-file walk, the
+call graph (graph.py) BFS-reaches every function a serve-scope function
+can call — across files, through from-imports and inferable method
+receivers, with bounded depth — and flags blocking calls found in those
+helpers too, printing the discovery chain (``[reachable via
+batcher.flush -> engine.drain -> ...]``). A helper that blocks is exactly
+as fatal to the event loop as blocking inline; the suppression (or the
+fix) belongs at the blocking call site, which is where the finding lands.
 """
 from __future__ import annotations
 
@@ -49,6 +58,7 @@ import re
 from pathlib import Path
 
 from .core import FileContext, Finding, dotted_name
+from .graph import format_chain
 
 #: data-plane module stems the rule applies to (serve/ modules face the
 #: same untrusted traffic the farm does: admission decisions and shed
@@ -177,6 +187,16 @@ def _sleep_aliases(tree: ast.Module) -> set[str]:
     return names
 
 
+def _blocking_name(name: str, sleep_names: set[str]) -> bool:
+    tail = name.rsplit(".", 1)[-1]
+    return (
+        name in _BLOCKING_CALLS
+        or name.startswith(_BLOCKING_PREFIXES)
+        or tail in _BLOCKING_ATTRS
+        or name in sleep_names
+    )
+
+
 def _check_am403(ctx: FileContext, findings: list[Finding]) -> None:
     sleep_names = _sleep_aliases(ctx.tree)
     for node in ast.walk(ctx.tree):
@@ -185,14 +205,7 @@ def _check_am403(ctx: FileContext, findings: list[Finding]) -> None:
         name = dotted_name(node.func)
         if name is None:
             continue
-        tail = name.rsplit(".", 1)[-1]
-        blocking = (
-            name in _BLOCKING_CALLS
-            or name.startswith(_BLOCKING_PREFIXES)
-            or tail in _BLOCKING_ATTRS
-            or name in sleep_names
-        )
-        if blocking:
+        if _blocking_name(name, sleep_names):
             findings.append(ctx.finding(
                 "AM403", node,
                 f"blocking {name}() call in serve event-loop code: one "
@@ -204,8 +217,56 @@ def _check_am403(ctx: FileContext, findings: list[Finding]) -> None:
             ))
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def _check_am403_transitive(ctxs: list[FileContext], graph,
+                            findings: list[Finding]) -> None:
+    """Blocking calls in helpers the serve layer reaches through the call
+    graph. Serve-scope files themselves are owned by the direct walk — the
+    transitive pass only reports in files *outside* serve scope, so no call
+    site is ever double-flagged."""
+    if graph is None:
+        return
+    roots = []
+    serve_ctx_ids: set[int] = set()
+    for ctx in ctxs:
+        if not _in_serve_scope(ctx):
+            continue
+        serve_ctx_ids.add(id(ctx))
+        mod = graph.module_for(ctx)
+        if mod is not None:
+            roots.extend(mod.functions.values())
+    if not roots:
+        return
+    sleep_cache: dict[int, set[str]] = {}
+    emitted: set[tuple[str, int, int]] = set()
+    for fi, chain in graph.reachable(roots).values():
+        if id(fi.ctx) in serve_ctx_ids:
+            continue
+        if id(fi.ctx) not in sleep_cache:
+            sleep_cache[id(fi.ctx)] = _sleep_aliases(fi.ctx.tree)
+        sleep_names = sleep_cache[id(fi.ctx)]
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or not _blocking_name(name, sleep_names):
+                continue
+            key = (str(fi.ctx.path), node.lineno, node.col_offset)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            findings.append(fi.ctx.finding(
+                "AM403", node,
+                f"blocking {name}() call reachable from serve event-loop "
+                "code: a helper that blocks stalls every client channel "
+                "exactly like blocking inline — yield, take an injected "
+                "clock, or justify-suppress at this call site"
+                + format_chain(chain),
+            ))
+
+
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
+    _check_am403_transitive(ctxs, graph, findings)
     for ctx in ctxs:
         if _in_sync_scope(ctx):
             _check_am402(ctx, findings)

@@ -18,9 +18,13 @@ The checker builds a per-module view of traced code:
   propagates through expressions and assignments, and is *blocked* by the
   static accessors (``.shape``, ``.dtype``, ``.ndim``, ``len()``) — shape
   math is host-side and branching on it is legal;
-- **interprocedural**: a direct call from traced code taints the callee's
+- **interprocedural**: a call from traced code taints the callee's
   parameters positionally, so shared helpers are checked under the taint
-  they actually receive.
+  they actually receive. Resolution is whole-scan (graph.py): direct
+  same-module calls, from-imported helpers in other scanned modules, and
+  ``Class.meth``/module-alias attribute targets all propagate taint, with
+  the discovery chain carried along so every diagnostic prints the actual
+  ``[reachable via root -> helper -> ...]`` path from its trace root.
 
 Rules:
 - AM201: ``if``/``while``/``assert``/``and``/``or``/ternary/``for`` over a
@@ -42,6 +46,7 @@ from __future__ import annotations
 import ast
 
 from .core import FileContext, Finding, dotted_name
+from .graph import format_chain
 
 _JIT_DECORATORS = {"jit", "vmap", "pmap", "profiled_jit"}
 _COMBINATORS = {
@@ -197,9 +202,55 @@ def _assigned_names(fn) -> set[str]:
     return out
 
 
+class _Coordinator:
+    """Whole-scan driver: one checker per file, one shared worklist of
+    ``(checker, fn, tainted params, discovery chain)`` items, so taint
+    crossing a module boundary lands in the right file's checker with the
+    chain that got it there."""
+
+    def __init__(self, ctxs: list[FileContext], graph=None,
+                 checker_cls=None):
+        self.graph = graph
+        cls = checker_cls or _ModuleChecker
+        self.checkers: dict[int, _ModuleChecker] = {
+            id(ctx): cls(ctx, self) for ctx in ctxs
+        }
+        self.worklist: list[tuple] = []
+
+    def enqueue(self, checker, fn, tainted: frozenset,
+                chain: tuple[str, ...]) -> None:
+        self.worklist.append((checker, fn, tainted, chain))
+
+    def enqueue_info(self, fi, tainted: frozenset,
+                     chain: tuple[str, ...]) -> None:
+        """Cross-module hop: route a graph-resolved FuncInfo to the
+        checker that owns its file, extending the chain."""
+        checker = self.checkers.get(id(fi.ctx))
+        if checker is not None:
+            self.worklist.append(
+                (checker, fi.node, tainted, chain + (fi.label,))
+            )
+
+    def run(self) -> list[Finding]:
+        for checker in self.checkers.values():
+            checker.seed()
+        while self.worklist:
+            checker, fn, tainted, chain = self.worklist.pop()
+            key = (id(fn), tainted)
+            if key in checker._done:
+                continue
+            checker._done.add(key)
+            checker._analyze_function(fn, tainted, chain)
+        findings: list[Finding] = []
+        for checker in self.checkers.values():
+            findings.extend(checker.findings)
+        return findings
+
+
 class _ModuleChecker:
-    def __init__(self, ctx: FileContext):
+    def __init__(self, ctx: FileContext, coordinator: _Coordinator = None):
         self.ctx = ctx
+        self.coordinator = coordinator
         self.tree = ctx.tree
         self.np_aliases = _np_aliases(ctx.tree)
         self.jnp_aliases = _jnp_aliases(ctx.tree)
@@ -214,11 +265,16 @@ class _ModuleChecker:
         # (func name, frozenset of tainted params) already analyzed
         self._done: set[tuple[int, frozenset]] = set()
         self.traced_names: set[str] = set()
+        #: chain of the function currently under analysis — every finding
+        #: it emits prints the path from its trace root
+        self._current_chain: tuple[str, ...] = ()
 
     # ------------------------------------------------------------------ #
 
-    def run(self) -> list[Finding]:
-        worklist: list[tuple[ast.AST, frozenset]] = []
+    def seed(self) -> None:
+        """Discovers this module's trace roots and enqueues them on the
+        coordinator with single-element chains."""
+        co = self.coordinator
 
         for fn in self.module_funcs.values():
             for dec in fn.decorator_list:
@@ -229,7 +285,7 @@ class _ModuleChecker:
                         p for i, p in enumerate(params)
                         if i not in nums and p not in names
                     )
-                    worklist.append((fn, tainted))
+                    co.enqueue(self, fn, tainted, (fn.name,))
                     self.traced_names.add(fn.name)
                     break
 
@@ -240,7 +296,7 @@ class _ModuleChecker:
                 p for i, p in enumerate(params)
                 if i >= exempt_count and p not in exempt_names
             )
-            worklist.append((fn, tainted))
+            co.enqueue(self, fn, tainted, (fn.name,))
             self.traced_names.add(fn.name)
 
         # nested defs passed to combinators inside otherwise-host functions
@@ -262,16 +318,19 @@ class _ModuleChecker:
                     p for i, p in enumerate(params)
                     if i >= exempt_count and p not in exempt_names
                 )
-                worklist.append((sub, tainted))
+                co.enqueue(self, sub, tainted, (sub.name,))
 
-        while worklist:
-            fn, tainted = worklist.pop()
-            key = (id(fn), tainted)
-            if key in self._done:
-                continue
-            self._done.add(key)
-            self._analyze_function(fn, tainted, worklist)
-        return self.findings
+    def resolve_cross(self, call: ast.Call):
+        """Graph resolution for calls the per-module lookup missed:
+        from-imported helpers, module-alias attributes, same-scan class
+        methods. Returns a FuncInfo or None."""
+        co = self.coordinator
+        if co is None or co.graph is None:
+            return None
+        mod = co.graph.module_for(self.ctx)
+        if mod is None:
+            return None
+        return co.graph.resolve_call(mod, call.func)
 
     def _combinator_refs(self, scope: ast.AST, local_funcs=None):
         """(function node, partial-bound kwnames, partial-bound positional
@@ -308,19 +367,24 @@ class _ModuleChecker:
         key = (rule_id, getattr(node, "lineno", 1), getattr(node, "col_offset", 0))
         if key not in self._emitted:
             self._emitted.add(key)
+            if self._current_chain:
+                message += format_chain(self._current_chain)
             self.findings.append(self.ctx.finding(rule_id, node, message))
 
-    def _analyze_function(self, fn, tainted: frozenset, worklist) -> None:
+    def _analyze_function(self, fn, tainted: frozenset,
+                          chain: tuple[str, ...]) -> None:
         locals_ = _assigned_names(fn)
         nested = {
             n.name: n for n in ast.walk(fn)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
         }
         env = set(tainted)
-        state = _FnState(self, fn, locals_, nested, worklist)
+        self._current_chain = chain
+        state = _FnState(self, fn, locals_, nested, chain)
         # pass 1: propagate taint (loops make later lines feed earlier ones);
         # pass 2: report with the stable env
         state.walk_block(fn.body, env, report=False)
+        self._current_chain = chain  # a recursed nested def may have moved it
         state.walk_block(fn.body, env, report=True)
 
         # nested functions referenced in combinators run traced with the
@@ -336,7 +400,7 @@ class _ModuleChecker:
             key = (id(sub), sub_tainted)
             if key not in self._done:
                 self._done.add(key)
-                self._analyze_function(sub, sub_tainted, worklist)
+                self._analyze_function(sub, sub_tainted, chain + (sub.name,))
         # pl.when-decorated nested defs execute inside the trace
         for sub in nested.values():
             for dec in sub.decorator_list:
@@ -349,18 +413,21 @@ class _ModuleChecker:
                     key = (id(sub), sub_tainted)
                     if key not in self._done:
                         self._done.add(key)
-                        self._analyze_function(sub, sub_tainted, worklist)
+                        self._analyze_function(
+                            sub, sub_tainted, chain + (sub.name,)
+                        )
+        self._current_chain = ()
 
 
 class _FnState:
     """Per-function walk: statement-ordered taint propagation + findings."""
 
-    def __init__(self, mod: _ModuleChecker, fn, locals_, nested, worklist):
+    def __init__(self, mod: _ModuleChecker, fn, locals_, nested, chain):
         self.mod = mod
         self.fn = fn
         self.locals = locals_
         self.nested = nested
-        self.worklist = worklist
+        self.chain = chain
         self.report = False
 
     # ------------------------------ statements ------------------------ #
@@ -609,15 +676,22 @@ class _FnState:
                       "captured host state inside traced code "
                       f"({self.fn.name})")
 
-        # direct call into another module-level (or sibling nested)
-        # function: propagate taint positionally
+        # call into another function: propagate taint positionally.
+        # Same-module defs resolve directly; everything else (from-imports,
+        # module aliases, same-scan class methods) goes through the graph.
         callee = None
         if isinstance(node.func, ast.Name):
             callee = self.nested.get(node.func.id) or mod.module_funcs.get(
                 node.func.id
             )
-        if callee is not None:
-            params = _param_names(callee)
+        cross = None
+        if callee is None and args_tainted:
+            cross = mod.resolve_cross(node)
+        target = callee if callee is not None else (
+            cross.node if cross is not None else None
+        )
+        if target is not None and target is not self.fn:
+            params = _param_names(target)
             tainted_params = frozenset(
                 params[i] for i, t in enumerate(arg_taints)
                 if t and i < len(params)
@@ -626,7 +700,15 @@ class _FnState:
                 if t and kw.arg
             )
             if tainted_params:
-                self.worklist.append((callee, tainted_params))
+                if callee is not None:
+                    mod.coordinator.enqueue(
+                        mod, callee, tainted_params,
+                        self.chain + (callee.name,)
+                    )
+                else:
+                    mod.coordinator.enqueue_info(
+                        cross, tainted_params, self.chain
+                    )
 
         func_taint = False
         if isinstance(node.func, ast.Attribute):
@@ -665,9 +747,8 @@ def _check_dtypes(ctx: FileContext) -> list[Finding]:
     return findings
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
-    findings: list[Finding] = []
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
+    findings = _Coordinator(ctxs, graph).run()
     for ctx in ctxs:
-        findings += _ModuleChecker(ctx).run()
         findings += _check_dtypes(ctx)
     return findings

@@ -49,6 +49,17 @@ Scope (both rules): modules whose filename stem is in ``WORKER_STEMS``,
 plus any file carrying a ``# amlint: mesh-worker`` marker (the fixture
 hook, and the opt-in for future worker-executed modules living
 elsewhere).
+
+Both rules are *transitively* enforced: beyond the direct per-statement
+walk, the module-import closure (graph.import_closure, bounded depth)
+is checked — a worker module that imports an innocent helper which in
+turn imports ``meshfarm``/``serve`` (AM502) or the ``obs.export``
+exposition layer (AM305) drags the same machinery into every spawned
+child, two hops removed. The finding anchors on the *first-hop* import
+statement in the worker module (that line owns the fix) and prints the
+module chain (``[reachable via workers -> helper -> meshfarm]``).
+Direct edges (chain length 2) are owned by the direct walk and never
+double-flagged.
 """
 from __future__ import annotations
 
@@ -57,6 +68,7 @@ import re
 from pathlib import Path
 
 from .core import FileContext, Finding, dotted_name
+from .graph import format_chain
 
 #: modules whose code executes inside spawned mesh worker processes
 WORKER_STEMS = frozenset({"workers"})
@@ -125,11 +137,46 @@ def _exposition_import(node: ast.AST) -> set[str]:
     return set()
 
 
-def check(ctxs: list[FileContext]) -> list[Finding]:
+def _check_transitive(ctx: FileContext, graph,
+                      findings: list[Finding]) -> None:
+    """Controller/exposition modules reached through the import closure.
+    Chain length 2 is a direct import — the per-statement walk owns it."""
+    if graph is None:
+        return
+    mod = graph.module_for(ctx)
+    if mod is None:
+        return
+    for target, (chain, anchor) in sorted(graph.import_closure(mod.name).items()):
+        if len(chain) <= 2:
+            continue
+        short = tuple(name.rsplit(".", 1)[-1] for name in chain)
+        parts = set(target.split("."))
+        if CONTROLLER_SEGMENTS & parts:
+            findings.append(ctx.finding(
+                "AM502", anchor,
+                f"worker-executed module transitively imports the mesh "
+                f"controller layer ({target}): this import drags the "
+                "routing/fan-in machinery into every spawned child — break "
+                "the chain at this line or move the helper out of the "
+                "controller's import graph" + format_chain(short),
+            ))
+        elif "export" in parts:
+            findings.append(ctx.finding(
+                "AM305", anchor,
+                f"worker-executed module transitively imports the telemetry "
+                f"exposition layer ({target}): a worker must not publish "
+                "its own registry — telemetry ships over the pipe or the "
+                "black-box file only; break the chain at this line"
+                + format_chain(short),
+            ))
+
+
+def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
     findings: list[Finding] = []
     for ctx in ctxs:
         if not _in_scope(ctx):
             continue
+        _check_transitive(ctx, graph, findings)
         for node in ast.walk(ctx.tree):
             if _controller_import(node):
                 findings.append(ctx.finding(

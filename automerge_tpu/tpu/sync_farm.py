@@ -190,6 +190,11 @@ class SyncFarm:
                 )
                 counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
             num_words = int(ceil(width * BITS_PER_ENTRY / WORD_BITS)) or 1
+            # amlint: disable=AM701 — pad-to-bucket idiom: `pad` is
+            # _pow2(len(lists)) - len(lists), dynamic on its own, but the
+            # concatenate grows the batch TO the pow2 bucket, so the
+            # leading dim build_filters sees is _pow2(n) — shape-stable by
+            # construction. The dataflow engine cannot prove the sum.
             words, modulo = build_filters(xyz, counts, num_words)
             blooms = filters_to_bytes(words, modulo, counts)
             for i, bloom in zip(build_idx, blooms):

@@ -1,0 +1,38 @@
+"""AM503 clean fixture: the pipe contract holds — every sent op has a
+handler arm and vice versa, responses are 4-tuples and requests
+2-tuples at every construction and unpack site, and every response
+field the controller reads is written by a worker-side producer."""
+# amlint: pipe-protocol
+
+
+def result_to_wire():
+    resp = {"patches": [], "outcomes": []}
+    resp["wall_s"] = 0.0
+    return resp
+
+
+def worker_loop(conn):
+    while True:
+        op, payload = conn.recv()
+        if op == "shutdown":
+            conn.send(("ok", None, {}, []))
+            return
+        if op == "apply":
+            conn.send(("ok", result_to_wire(), {}, []))
+
+
+class Handle:
+    def request(self, op, payload):
+        self.conn.send((op, payload))
+
+    def close(self):
+        self.conn.send(("shutdown", None))
+
+    def apply(self, payload):
+        resp = self.call("apply", payload)
+        return resp["patches"], resp.get("wall_s")
+
+    def call(self, op, payload):
+        self.request(op, payload)
+        status, data, metrics, events = self._recv()
+        return data

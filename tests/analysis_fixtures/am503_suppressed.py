@@ -1,0 +1,19 @@
+"""AM503 suppressed fixture: a justified dead handler (staged rollout —
+the sender ships in the next release, the handler lands first so old
+controllers never hit an unhandled op)."""
+# amlint: pipe-protocol
+
+
+def worker_loop(conn):
+    op, payload = conn.recv()
+    if op == "apply":
+        conn.send(("ok", {}, {}, []))
+    # amlint: disable=AM503 — fixture: handler lands one release before
+    # its sender so mixed fleets stay compatible during the rollout
+    if op == "get_stats":
+        conn.send(("ok", {}, {}, []))
+
+
+class Handle:
+    def apply(self, payload):
+        return self.call("apply", payload)

@@ -1,0 +1,34 @@
+"""AM503 violating fixture: pipe-protocol drift in one mini
+controller/worker pair — a response frame missing its flight_events
+element, a dead handler, a sent op with no handler arm, and a response
+field read that no worker-side producer writes."""
+# amlint: pipe-protocol
+
+
+def _do_apply(payload):
+    resp = {"outcomes": []}
+    resp["wall_s"] = 0.0
+    return resp
+
+
+def worker_loop(conn):
+    while True:
+        op, payload = conn.recv()
+        if op == "shutdown":
+            conn.send(("ok", None, {}))  # 3-tuple: drops flight_events
+            return
+        if op == "get_stats":  # dead handler: nothing sends get_stats
+            conn.send(("ok", {}, {}, []))
+        if op == "apply":
+            conn.send(("ok", _do_apply(payload), {}, []))
+
+
+class Handle:
+    def apply(self, payload):
+        resp = self.call("apply_changes", payload)  # no handler arm
+        return resp["patches"]  # no producer writes "patches"
+
+    def call(self, op, payload):
+        self.conn.send((op, payload))
+        status, data, metrics, events = self._recv()
+        return data

@@ -1,0 +1,23 @@
+"""AM701 clean fixture: lengths are pow2-bucketed before the dispatch.
+
+The executable twin of am701_violation.py: the same four batch lengths
+collapse onto at most two pow2 buckets, so the runtime storm detector
+stays quiet and the static rule sees a sanitizer on every dataflow path.
+"""
+import jax.numpy as jnp
+
+from automerge_tpu.tpu.sync_farm import _pow2
+from automerge_tpu.tpu.jitprof import profiled_jit
+
+
+@profiled_jit("fixture.shape.bucketed")
+def _embed(xs):
+    return xs * 2
+
+
+def drive(batches):
+    outs = []
+    for rows in batches:
+        n = _pow2(max(len(rows), 1))
+        outs.append(_embed(jnp.zeros((n,), dtype=jnp.int32)))
+    return outs

@@ -1,0 +1,19 @@
+"""AM701 suppressed fixture: a deliberately shape-dynamic dispatch."""
+import jax.numpy as jnp
+
+from automerge_tpu.tpu.jitprof import profiled_jit
+
+
+@profiled_jit("fixture.shape.justified")
+def _embed(xs):
+    return xs * 2
+
+
+def drive(batches):
+    outs = []
+    for rows in batches:
+        n = len(rows)
+        # amlint: disable=AM701 — fixture: one-shot offline tool, each
+        # length dispatches exactly once so there is no storm to bucket
+        outs.append(_embed(jnp.zeros((n,), dtype=jnp.int32)))
+    return outs

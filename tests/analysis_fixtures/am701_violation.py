@@ -1,0 +1,24 @@
+"""AM701 violating fixture: a raw ``len()`` feeds the jit dispatch shape.
+
+Deliberately executable: tests/test_static_analysis.py drives ``drive``
+under an enabled observatory+flight and asserts the runtime twin
+(``prof.recompile.storm``) fires for the same dispatch the static rule
+flags — four distinct batch lengths mean four distinct shapes mean four
+XLA compiles inside the storm window.
+"""
+import jax.numpy as jnp
+
+from automerge_tpu.tpu.jitprof import profiled_jit
+
+
+@profiled_jit("fixture.shape.raw")
+def _embed(xs):
+    return xs * 2
+
+
+def drive(batches):
+    outs = []
+    for rows in batches:
+        n = len(rows)
+        outs.append(_embed(jnp.zeros((n,), dtype=jnp.int32)))
+    return outs

@@ -9,6 +9,9 @@ Two jobs:
    clean, and a suppressed fixture under tests/analysis_fixtures/, and the
    CLI contract (exit 0 clean / exit 1 findings) is pinned.
 """
+import importlib.util
+import json
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -48,7 +51,10 @@ def test_repo_suppressions_are_justified():
     buffer sites AM502/AM305 mark in parallel/workers.py, and the store
     tier's own write primitives — the atomic writer's tmp-file handle
     and the WAL's checksummed appender — which AM601 marks in
-    store/atomic.py and store/wal.py), proving the suppression path is
+    store/atomic.py and store/wal.py, and the pad-to-pow2-bucket
+    concatenate in tpu/sync_farm.py whose resulting leading dim is
+    shape-stable by construction even though AM701's dataflow engine
+    sees a raw ``len()`` feeding it), proving the suppression path is
     exercised in-tree, and each sits on a line whose surrounding comment
     carries a justification."""
     everything = run_analysis([PACKAGE], include_suppressed=True)
@@ -56,7 +62,7 @@ def test_repo_suppressions_are_justified():
     assert suppressed, "expected in-tree justified suppressions"
     assert {f.rule_id for f in suppressed} == {
         "AM103", "AM105", "AM106", "AM107", "AM305", "AM401", "AM402",
-        "AM502", "AM601",
+        "AM502", "AM601", "AM701",
     }
 
 
@@ -165,3 +171,266 @@ def test_am304_catalog_shorthand_and_placeholders_parse():
 
 
 REPO_README = Path(__file__).parent.parent / "README.md"
+
+
+# --------------------------------------------------------------------- #
+# meta-coverage: rules <-> fixtures <-> README catalog, both directions
+
+
+def test_every_rule_has_fixture_triple_and_readme_row():
+    """Forward direction: registering a rule obliges a violating/clean/
+    suppressed fixture triple AND a README rule-catalog row — a rule
+    cannot ship undocumented or untested."""
+    text = REPO_README.read_text(encoding="utf-8")
+    rows = set(re.findall(r"\|\s*(AM\d{3})\b", text))
+    for rule_id in RULE_IDS:
+        for kind in ("violation", "clean", "suppressed"):
+            fixture = FIXTURES / f"{rule_id.lower()}_{kind}.py"
+            assert fixture.exists(), f"missing fixture {fixture.name}"
+        assert rule_id in rows, f"README catalog row missing for {rule_id}"
+
+
+def test_fixtures_and_readme_rows_name_registered_rules():
+    """Reverse direction: every fixture file and every README table cell
+    that names a rule id must point at a *registered* rule — deleting a
+    rule obliges cleaning up its fixtures and docs."""
+    for path in sorted(FIXTURES.glob("*.py")):
+        m = re.match(r"(am\d{3})_(violation|clean|suppressed)$", path.stem)
+        assert m, f"stray fixture file {path.name}"
+        assert m.group(1).upper() in RULES, (
+            f"{path.name} names unregistered rule {m.group(1).upper()}"
+        )
+    text = REPO_README.read_text(encoding="utf-8")
+    rows = set(re.findall(r"\|\s*(AM\d{3})\b", text))
+    unknown = sorted(rows - set(RULES))
+    assert not unknown, f"README names unregistered rule(s): {unknown}"
+
+
+# --------------------------------------------------------------------- #
+# whole-program call graph + transitive reachability diagnostics
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text, encoding="utf-8")
+    return p
+
+
+def test_callgraph_reachable_chain(tmp_path):
+    """Cross-module call resolution (module-alias attribute + local name)
+    and the shortest-discovery-path chain reachable() reports."""
+    from automerge_tpu.analysis.core import FileContext
+    from automerge_tpu.analysis.graph import CallGraph
+
+    a = _write(tmp_path, "alpha.py",
+               "import beta\n\n\ndef entry():\n    beta.helper()\n")
+    b = _write(tmp_path, "beta.py",
+               "def helper():\n    leaf()\n\n\ndef leaf():\n    pass\n")
+    graph = CallGraph([FileContext(a, str(a)), FileContext(b, str(b))])
+    root = graph.modules["alpha"].functions["entry"]
+    reached = graph.reachable([root])
+    chains = {fi.label: chain for fi, chain in reached.values()}
+    assert chains["beta.leaf"] == ("alpha.entry", "beta.helper", "beta.leaf")
+    assert chains["beta.helper"] == ("alpha.entry", "beta.helper")
+
+
+def test_callgraph_import_closures(tmp_path):
+    """import_closure walks transitive imports with the first-hop anchor;
+    importers_closure inverts the edges (the --changed widening set)."""
+    from automerge_tpu.analysis.core import FileContext
+    from automerge_tpu.analysis.graph import CallGraph
+
+    files = [
+        _write(tmp_path, "top.py", '"""top."""\nimport mid\n'),
+        _write(tmp_path, "mid.py", '"""mid."""\nimport leafmod\n'),
+        _write(tmp_path, "leafmod.py", '"""leaf."""\n'),
+        _write(tmp_path, "loner.py", '"""unrelated."""\n'),
+    ]
+    graph = CallGraph([FileContext(p, str(p)) for p in files])
+    closure = graph.import_closure("top")
+    assert closure["leafmod"][0] == ("top", "mid", "leafmod")
+    assert "loner" not in closure
+    assert graph.importers_closure({"leafmod"}) == {"top", "mid"}
+
+
+def test_am403_transitive_finding_prints_call_chain(tmp_path):
+    """A blocking call in a helper module outside serve scope is flagged
+    when a serve event-loop function reaches it through the call graph,
+    and the diagnostic carries the actual call path."""
+    _write(tmp_path, "srv.py",
+           "# amlint: serve-event-loop\nimport helper\n\n\n"
+           "def handle():\n    helper.drain()\n")
+    _write(tmp_path, "helper.py",
+           "import time\n\n\ndef drain():\n    time.sleep(0.1)\n")
+    findings = [f for f in run_analysis([tmp_path]) if f.rule_id == "AM403"]
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert findings[0].path.endswith("helper.py")
+    assert ("[reachable via srv.handle -> helper.drain]"
+            in findings[0].message)
+
+
+def test_am502_transitive_import_chain(tmp_path):
+    """A worker module reaching a controller module through an innocent
+    intermediary is flagged at the first-hop import, with the module
+    chain in the diagnostic."""
+    for name, body in [
+        ("workers.py", '"""worker."""\nimport innocent\n'),
+        ("innocent.py", '"""glue."""\nimport meshfarm\n'),
+        ("meshfarm.py", '"""controller."""\n'),
+    ]:
+        _write(tmp_path, name, body)
+    findings = [f for f in run_analysis([tmp_path]) if f.rule_id == "AM502"]
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert findings[0].path.endswith("workers.py")
+    assert ("[reachable via workers -> innocent -> meshfarm]"
+            in findings[0].message)
+
+
+# --------------------------------------------------------------------- #
+# AM701 <-> amprof storm parity: the static rule and the runtime
+# detector must agree on the same fixture pair
+
+
+def _load_fixture_module(stem):
+    spec = importlib.util.spec_from_file_location(
+        stem, FIXTURES / f"{stem}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_am701_static_and_runtime_storm_parity():
+    """The acceptance contract for the shape family: the violating
+    fixture provokes ``prof.recompile.storm`` at runtime (four distinct
+    batch lengths = four compiles inside the storm window) AND is
+    statically flagged with a dataflow chain; the pow2-bucketed twin is
+    quiet on both sides."""
+    from automerge_tpu.obs.flight import enabled_flight
+    from automerge_tpu.obs.prof import enabled_observatory, get_observatory
+
+    batches = [[0] * n for n in (33, 57, 91, 123)]
+    storms = {}
+    for stem in ("am701_violation", "am701_clean"):
+        mod = _load_fixture_module(stem)
+        get_observatory().reset()
+        with enabled_observatory(), enabled_flight() as flight:
+            mod.drive(batches)
+            storms[stem] = [
+                e for e in flight.snapshot()
+                if e["event"] == "prof.recompile.storm"
+            ]
+    assert any(e["fields"]["program"] == "fixture.shape.raw"
+               for e in storms["am701_violation"]), (
+        "raw-length fixture must trip the runtime storm detector"
+    )
+    assert not any(e["fields"].get("program") == "fixture.shape.bucketed"
+                   for e in storms["am701_clean"]), (
+        "bucketed fixture must stay under the storm threshold"
+    )
+    raw = run_analysis([FIXTURES / "am701_violation.py"])
+    assert any(f.rule_id == "AM701" and "[dataflow:" in f.message
+               for f in raw), [f.format() for f in raw]
+    assert run_analysis([FIXTURES / "am701_clean.py"]) == []
+
+
+# --------------------------------------------------------------------- #
+# CLI contract: usage errors exit 2 with one-line stderr, --select,
+# --changed and --json
+
+
+def test_cli_usage_errors_exit_2(tmp_path, capsys):
+    assert amlint_main(
+        ["--select", "AM999", str(FIXTURES / "am101_clean.py")]
+    ) == 2
+    assert amlint_main([str(tmp_path / "does_not_exist.py")]) == 2
+    typo = _write(tmp_path, "typo.py", "x = 1  # amlint: disable=AM999\n")
+    assert amlint_main([str(typo)]) == 2
+    err = capsys.readouterr().err
+    assert err.count("amlint: error:") == 3, err
+    assert "Traceback" not in err
+
+
+def test_cli_usage_error_subprocess_never_tracebacks():
+    proc = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.analysis",
+         "--select", "AMXXX", str(FIXTURES / "am101_clean.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert proc.stderr.strip().startswith("amlint: error:")
+    assert "Traceback" not in proc.stderr
+
+
+def test_cli_changed_bad_ref_exits_2():
+    proc = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.analysis",
+         "--changed", "no-such-ref-xyz", "automerge_tpu"],
+        capture_output=True, text=True,
+        cwd=str(Path(__file__).parent.parent),
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "amlint: error:" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+def test_cli_changed_incremental_and_full_scan_modes(tmp_path):
+    """--changed lints changed files plus transitive importers; touching
+    a module in the import graph of a rule-scoped one (here: an
+    untracked workers.py) falls back to the full scan. The chosen mode
+    is announced on stderr either way."""
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(Path(__file__).parent.parent)
+
+    def git(*argv):
+        subprocess.run(["git", *argv], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.invalid")
+    git("config", "user.name", "t")
+    _write(tmp_path, "base.py", '"""base."""\nX = 1\n')
+    _write(tmp_path, "user.py", '"""user."""\nimport base\n')
+    _write(tmp_path, "loner.py", '"""unrelated."""\n')
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    _write(tmp_path, "base.py", '"""base."""\nX = 2\n')
+
+    argv = [sys.executable, "-m", "automerge_tpu.analysis",
+            "--changed", "HEAD", "--json", str(tmp_path)]
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          cwd=str(tmp_path), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "incremental: 2 of 3 file(s)" in proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["active"] == 0 and payload["findings"] == []
+
+    _write(tmp_path, "workers.py", '"""worker."""\nimport base\n')
+    proc = subprocess.run(argv, capture_output=True, text=True,
+                          cwd=str(tmp_path), env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "full scan:" in proc.stderr
+
+
+def test_cli_json_output_in_process(capsys):
+    rc = amlint_main(["--json", str(FIXTURES / "am102_violation.py")])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["active"] >= 1
+    assert any(f["rule"] == "AM102" for f in payload["findings"])
+    assert {"rule", "path", "line", "col", "message", "suppressed"} <= set(
+        payload["findings"][0]
+    )
+
+
+def test_cli_select_filters_report(capsys):
+    rc = amlint_main(
+        ["--select", "AM503", "--json",
+         str(FIXTURES / "am503_violation.py")]
+    )
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"]
+    assert all(f["rule"] == "AM503" for f in payload["findings"])
