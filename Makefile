@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers prof store
+.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers prof store sync2
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -88,6 +88,15 @@ mesh-workers:
 # quick gates are tier-1 as tests/test_store_smoke.py
 store:
 	$(PY) bench.py --store --quick
+
+# sync v2 smoke (README "Resilient sync"): Bloom (v1) vs range
+# reconciliation (v2) — deterministic round-trip bound, the poisoned
+# sentHashes stall that only v1's watchdog can break, byte-for-byte
+# v1<->v2 interop, and the one-dispatch-per-sweep farm fingerprint pin.
+# The full SYNC_r01 record run (1e5-change divergence):
+# `python bench.py --sync2`
+sync2:
+	JAX_PLATFORMS=cpu $(PY) bench.py --sync2 --quick
 
 # amprof ledger smoke (README "Observability"): run the quick bench with
 # per-program compile/dispatch attribution + memory sampling, append the

@@ -60,7 +60,11 @@ Rule families (see core.RULES for the catalog):
   data-plane modules calling wall clocks or the global RNG directly
   instead of the injectable clock/RNG the chaos suite replays (AM402);
   blocking calls (time.sleep, bare socket, synchronous device readbacks)
-  inside serve/ event-loop code (AM403).
+  inside serve/ event-loop code (AM403); sync v2 wire-codec modules
+  (``sync_v2``, ``tpu/fingerprint``, the ``v2-wire-codec`` marker)
+  raising any exception class outside ``automerge_tpu.errors`` — the
+  negotiated fallback catches exactly the taxonomy, so anything else
+  kills the channel instead of downgrading it to v1 (AM404).
 - **AM5xx mesh**: dense per-doc ``range()`` statement loops in the mesh
   controller's routing/merge-result paths — sparse active lists and
   comprehensions keep per-delivery Python O(active), not O(farm)

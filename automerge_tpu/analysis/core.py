@@ -89,6 +89,12 @@ RULES: dict[str, tuple[str, str]] = {
                        "jax.device_get/block_until_ready) in serve/ "
                        "event-loop code (the loop must stay non-blocking; "
                        "justify dispatch-point suppressions)"),
+    "AM404": ("taxonomy", "non-taxonomy exception class raised in a sync v2 "
+                          "wire-codec module (sync_v2/tpu.fingerprint or "
+                          "`# amlint: v2-wire-codec`) — the session layer's "
+                          "negotiated fallback catches exactly the "
+                          "automerge_tpu.errors taxonomy, so any other class "
+                          "kills the channel instead of downgrading it to v1"),
     "AM501": ("mesh", "dense per-doc `for ... in range(...)` statement loop "
                       "in a mesh routing/merge-result path (build sparse "
                       "active lists with comprehensions or vectorize with "

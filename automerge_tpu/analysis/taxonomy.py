@@ -1,5 +1,5 @@
-"""AM401/AM402/AM403 — data-plane hygiene: classifiable errors, injectable
-time, non-blocking serve loops.
+"""AM401/AM402/AM403/AM404 — data-plane hygiene: classifiable errors,
+injectable time, non-blocking serve loops, taxonomy-only wire codecs.
 
 The fault-isolation layer (tpu/farm.py) routes per-document failures by
 taxonomy class (automerge_tpu/errors.py): ``DecodeError`` means re-request
@@ -42,6 +42,19 @@ single flush dispatch is the only place device latency may be paid, with a
 justified suppression) are all banned in serve modules (any file under a
 ``serve/`` directory, plus files marked ``# amlint: serve-event-loop``).
 
+AM404 tightens AM401 for the sync v2 wire codec (``sync_v2.py``,
+``tpu/fingerprint.py``, plus files carrying the ``v2-wire-codec`` marker):
+the session layer's negotiated-fallback dispatch catches exactly
+``SyncProtocolError`` — a v2 codec path that raises ANY class outside
+``automerge_tpu.errors`` (``RuntimeError``, ``KeyError``, a homegrown
+exception) would sail past the fallback handler and kill the channel
+instead of downgrading it to v1. So in v2 wire-codec scope every ``raise``
+of an exception *class* must name something imported from
+``automerge_tpu.errors`` — not just "no bare ValueError" (AM401) but
+"nothing outside the taxonomy at all". Re-raising a caught variable is
+fine; deliberate internal-invariant raises carry a justified
+``# amlint: disable=AM404`` suppression.
+
 AM403 is *transitively* enforced: beyond the direct per-file walk, the
 call graph (graph.py) BFS-reaches every function a serve-scope function
 can call — across files, through from-imports and inferable method
@@ -64,9 +77,9 @@ from .graph import format_chain
 #: same untrusted traffic the farm does: admission decisions and shed
 #: accounting key off error_kind too)
 DATA_PLANE_STEMS = frozenset({
-    "codecs", "columnar", "opset", "sync", "farm", "rga",
-    "sync_farm", "sync_batch", "sync_session", "transcode", "engine",
-    "text_engine", "server", "batcher", "loadgen", "meshfarm",
+    "codecs", "columnar", "opset", "sync", "sync_v2", "farm", "rga",
+    "sync_farm", "sync_batch", "sync_session", "fingerprint", "transcode",
+    "engine", "text_engine", "server", "batcher", "loadgen", "meshfarm",
 })
 
 _MARKER_RE = re.compile(r"#\s*amlint:\s*error-taxonomy")
@@ -79,9 +92,15 @@ _BARE = {"ValueError", "TypeError"}
 #: the serve layer runs whole fleets in simulated time, so it is held to
 #: the same injectable-clock discipline)
 SYNC_DATA_PLANE_STEMS = frozenset({
-    "sync", "sync_session", "sync_farm", "sync_batch",
-    "server", "batcher", "loadgen",
+    "sync", "sync_v2", "sync_session", "sync_farm", "sync_batch",
+    "fingerprint", "server", "batcher", "loadgen",
 })
+
+#: v2 wire-codec module stems AM404 applies to (the modules whose raises
+#: the session fallback dispatch must be able to classify)
+V2_WIRE_CODEC_STEMS = frozenset({"sync_v2", "fingerprint"})
+
+_V2_MARKER_RE = re.compile(r"#\s*amlint:\s*v2-wire-codec")
 
 _SYNC_MARKER_RE = re.compile(r"#\s*amlint:\s*sync-data-plane")
 
@@ -127,6 +146,60 @@ def _in_serve_scope(ctx: FileContext) -> bool:
         "serve" in Path(ctx.path).parts
         or _SERVE_MARKER_RE.search(ctx.source) is not None
     )
+
+
+def _in_v2_codec_scope(ctx: FileContext) -> bool:
+    return (
+        Path(ctx.path).stem in V2_WIRE_CODEC_STEMS
+        or _V2_MARKER_RE.search(ctx.source) is not None
+    )
+
+
+def _taxonomy_imports(tree: ast.Module) -> set[str]:
+    """Local names bound by ``from automerge_tpu.errors import ...`` (or the
+    relative ``from .errors import ...`` / ``from ..errors import ...``
+    spellings) — the only exception classes AM404 permits a v2 wire-codec
+    module to raise."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or node.module is None:
+            continue
+        if node.module != "errors" and not node.module.endswith(".errors"):
+            continue
+        if node.module == "errors" and node.level == 0:
+            continue  # an unrelated top-level `errors` package
+        for alias in node.names:
+            names.add(alias.asname or alias.name)
+    return names
+
+
+def _check_am404(ctx: FileContext, findings: list[Finding]) -> None:
+    taxonomy = _taxonomy_imports(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        if not isinstance(exc, ast.Name):
+            continue
+        # Only exception *classes* are policed; re-raising a caught
+        # lowercase variable (`raise exc`) is the wrap-and-rethrow idiom
+        # the taxonomy itself uses.
+        if not exc.id.endswith(("Error", "Exception")):
+            continue
+        if exc.id in taxonomy:
+            continue
+        findings.append(ctx.finding(
+            "AM404", node,
+            f"{exc.id} raised in a v2 wire-codec module: the session "
+            "layer's negotiated fallback catches exactly the taxonomy "
+            "(SyncProtocolError and friends from automerge_tpu.errors) — "
+            "any other class sails past the fallback dispatch and kills "
+            "the channel instead of downgrading it to v1; raise a "
+            "taxonomy error, or justify-suppress a deliberate "
+            "internal-invariant raise",
+        ))
 
 
 def _time_imports(tree: ast.Module) -> set[str]:
@@ -272,6 +345,8 @@ def check(ctxs: list[FileContext], graph=None) -> list[Finding]:
             _check_am402(ctx, findings)
         if _in_serve_scope(ctx):
             _check_am403(ctx, findings)
+        if _in_v2_codec_scope(ctx):
+            _check_am404(ctx, findings)
         if not _in_scope(ctx):
             continue
         for node in ast.walk(ctx.tree):

@@ -63,6 +63,7 @@ from ..obs.flight import get_flight
 from ..obs.metrics import get_metrics
 from ..obs.scope import dispatch_context, get_amscope
 from ..sync import decode_sync_message
+from ..sync_v2 import MESSAGE_TYPE_SYNC_V2, decode_sync_message_v2
 
 _AMSCOPE = get_amscope()
 _FLIGHT = get_flight()
@@ -312,9 +313,28 @@ class DynamicBatcher:
                 # still unacked, so re-processing it is the normal path).
                 deferred.append((channel, frame, scope))
                 continue
+            payload = pre["payload"]
+            is_v2 = bool(payload) and payload[0] == MESSAGE_TYPE_SYNC_V2
             try:
-                msg = decode_sync_message(pre["payload"])
+                msg = (
+                    decode_sync_message_v2(payload) if is_v2
+                    else decode_sync_message(payload)
+                )
             except (SyncProtocolError, ValueError, TypeError, IndexError):
+                if is_v2 and getattr(channel.session, "v2_local", False):
+                    # the v2 fallback contract (sync_session): a poisoned
+                    # v2 frame is ACKED with state unchanged — withholding
+                    # the ack would retransmit the same frame until
+                    # quarantine — and the session latches its downgrade
+                    # to v1. Route this rare path through the unbatched
+                    # receive, which carries exactly those semantics.
+                    patch = channel.session.handle(frame)
+                    report.committed.append((channel, patch))
+                    report.touched_docs.add(channel.doc)
+                    self._consume(channel)
+                    if scope is not None:
+                        _AMSCOPE.finish(scope, outcome="fallback")
+                    continue
                 # invalid inner payload: not committed, therefore not
                 # acked — the peer's intact retransmission retries
                 report.rejected += 1

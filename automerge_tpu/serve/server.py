@@ -33,6 +33,7 @@ Connect/resume/restart ride the existing epoch machinery:
 from __future__ import annotations
 
 import random
+from dataclasses import replace as _dc_replace
 
 from ..errors import (
     AdmissionRejectedError,
@@ -122,20 +123,29 @@ class AmServer:
     # -------------------------------------------------------------- #
     # connect / resume / restart
 
-    def connect(self, client_id, doc: int, tenant: str = "default"
-                ) -> ClientChannel:
+    def connect(self, client_id, doc: int, tenant: str = "default",
+                v2: bool | None = None) -> ClientChannel:
         """Opens (or returns) the channel for ``client_id``. Reconnects
         keep the existing server-side session: a restarted client arrives
         with a new epoch and the session's peer-restart detection
-        re-handshakes; a merely-reconnected client continues mid-stream."""
+        re-handshakes; a merely-reconnected client continues mid-stream.
+
+        ``v2`` overrides the server's default ``session_config.enable_v2``
+        for this channel (the per-client opt-in a ``HELLO ... v2`` token
+        carries); None inherits the server default. Enabling it only
+        *advertises* — the session still speaks byte-for-byte v1 to a
+        peer that never negotiates."""
         channel = self.channels.get(client_id)
         if channel is not None:
             self._active.add(client_id)
             return channel
+        config = self.session_config
+        if v2 is not None and v2 != config.enable_v2:
+            config = _dc_replace(config, enable_v2=v2)
         session = self.sync.make_session(
             doc, clock=self.clock,
             rng=random.Random(self.rng.getrandbits(64)),
-            config=self.session_config,
+            config=config,
         )
         return self._install(client_id, tenant, doc, session, _M_CONNECTS)
 
@@ -262,7 +272,11 @@ class AmServer:
         if need_generate:
             generate_t0 = self.clock()
             results = self.sync.generate_messages(
-                [(c.doc, c.session.state) for c in need_generate]
+                [(c.doc, c.session.state) for c in need_generate],
+                protocols=[
+                    "v2" if c.session.v2_active else "v1"
+                    for c in need_generate
+                ],
             )
             if _AMSCOPE.enabled:
                 _AMSCOPE.observe_phase(
@@ -317,7 +331,9 @@ class AmServer:
         connection is a text hello ``b"HELLO <client_id> <doc> <tenant>"``;
         everything after is session frames. Runs until cancelled. Returns
         the listening server object (``server.sockets[0].getsockname()``
-        for the bound port).
+        for the bound port). A fifth hello token ``v2`` opts the channel
+        into sync v2 negotiation (``HELLO <client_id> <doc> <tenant> v2``);
+        old clients' four-token hello keeps the pure-v1 channel.
 
         Live telemetry (obs/export.py): ``telemetry_port`` mounts the
         pull-based text exposition (metrics + tenant table with
@@ -378,11 +394,16 @@ class AmServer:
             try:
                 hello = await _read_frame(reader)
                 parts = hello.decode("utf-8").split()
-                if len(parts) != 4 or parts[0] != "HELLO":
+                if (
+                    len(parts) not in (4, 5)
+                    or parts[0] != "HELLO"
+                    or (len(parts) == 5 and parts[4] != "v2")
+                ):
                     writer.close()
                     return
                 client_id, doc, tenant = parts[1], int(parts[2]), parts[3]
-                self.connect(client_id, doc, tenant)
+                self.connect(client_id, doc, tenant,
+                             v2=True if len(parts) == 5 else None)
                 writers[client_id] = writer
                 while True:
                     frame = await _read_frame(reader)

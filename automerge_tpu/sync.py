@@ -213,8 +213,15 @@ def encode_sync_state(sync_state, session=None) -> bytes:
 
     `session`, when given, is the supervision envelope persisted by
     ``SyncSession.save()`` — ``{"epoch", "seqOut", "lastSeen",
-    "peerEpoch"}`` — appended as a versioned extension block that old
-    decoders skip as trailing bytes."""
+    "peerEpoch"}`` plus the watchdog counters (``wdRounds``, ``wdStage``,
+    ``wdStalls``, ``wdEscalations``, ``wdResets``) — appended as a
+    versioned extension block that old decoders skip as trailing bytes.
+    The watchdog fields sit AFTER the original extension fields so the
+    encoding is prefix-identical to pre-watchdog blobs: old decoders stop
+    after ``peerEpoch`` and ignore the tail, and blobs written before the
+    watchdog fields existed decode with the counters at zero (without the
+    tail a restart silently re-armed a stalled channel's escalation
+    ladder from scratch)."""
     encoder = Encoder()
     encoder.append_byte(PEER_STATE_TYPE)
     _encode_hashes(encoder, sync_state["sharedHeads"])
@@ -226,6 +233,11 @@ def encode_sync_state(sync_state, session=None) -> bytes:
         peer_epoch = session.get("peerEpoch")
         encoder.append_byte(0 if peer_epoch is None else 1)
         encoder.append_uint32(peer_epoch or 0)
+        encoder.append_uint32(session.get("wdRounds", 0))
+        encoder.append_uint32(session.get("wdStage", 0))
+        encoder.append_uint32(session.get("wdStalls", 0))
+        encoder.append_uint32(session.get("wdEscalations", 0))
+        encoder.append_uint32(session.get("wdResets", 0))
     return encoder.buffer
 
 
@@ -258,7 +270,20 @@ def decode_sync_state(data):
                 "seqOut": seq_out,
                 "lastSeen": last_seen,
                 "peerEpoch": peer_epoch if peer_known else None,
+                "wdRounds": 0,
+                "wdStage": 0,
+                "wdStalls": 0,
+                "wdEscalations": 0,
+                "wdResets": 0,
             }
+            if not decoder.done:
+                # watchdog/backoff tail (absent in blobs written before
+                # the counters were persisted; prefix-identical)
+                session["wdRounds"] = decoder.read_uint32()
+                session["wdStage"] = decoder.read_uint32()
+                session["wdStalls"] = decoder.read_uint32()
+                session["wdEscalations"] = decoder.read_uint32()
+                session["wdResets"] = decoder.read_uint32()
     except SyncProtocolError:
         raise
     except (ValueError, TypeError, IndexError) as exc:

@@ -44,6 +44,20 @@ Frame layout (outer framing only; ``FRAME_TYPE`` is disjoint from the
 
 ``seq`` is 0 on ack-only frames (they carry no payload and are never
 retransmitted); payload frames use a monotonic per-session sequence.
+
+**Protocol negotiation (sync v2).** ``FLAG_V2`` in the flags byte
+advertises that the sender speaks the range-based reconciliation
+protocol (automerge_tpu/sync_v2.py). Pre-v2 decoders only test
+``flags & FLAG_PAYLOAD``, so the bit is invisible to them — a v2 session
+talking to a v1 peer produces byte-for-byte the v1 exchange. A session
+switches to v2 generation only once BOTH sides have shown the flag
+(``v2_active``); inbound payloads dispatch on their leading type byte,
+so mixed-protocol transition windows are safe. If a v2 exchange errors,
+the session latches ``v2_fallback``: the failed inbound frame is acked
+(NOT withheld — a withheld ack would retransmit the same poisoned frame
+until quarantine), the flag is dropped from outgoing frames so the peer
+downgrades too, and the v1 machinery — Bloom filters, watchdog
+escalation ladder and all — takes over. Never a stalled channel.
 """
 # amlint: host-only — pure-host layer: must not import tpu/ or jax
 from __future__ import annotations
@@ -71,10 +85,18 @@ from .sync import (
     init_sync_state,
     receive_sync_message,
 )
+from .sync_v2 import (
+    MESSAGE_TYPE_SYNC_V2,
+    decode_sync_message_v2,
+    generate_sync_message_v2,
+    index_for_backend,
+    receive_sync_message_v2,
+)
 from .testing.faults import fire as _fault_point
 
 FRAME_TYPE = 0x44
 FLAG_PAYLOAD = 0x01
+FLAG_V2 = 0x02  # sender speaks range-based reconciliation (sync_v2)
 
 _CHECKSUM_SIZE = 4
 
@@ -142,6 +164,14 @@ _M_CHQ_RELEASED = _METRICS.counter(
 _M_CHQ_ACTIVE = _METRICS.gauge(
     "sync.channel.quarantine.active", "channels currently quarantined"
 )
+_M_V2_NEGOTIATED = _METRICS.counter(
+    "sync.v2.sessions.negotiated",
+    "sessions upgraded to range-based reconciliation (both sides flagged v2)",
+)
+_M_V2_FALLBACKS = _METRICS.counter(
+    "sync.v2.fallbacks",
+    "mid-session downgrades to the Bloom protocol after a v2 exchange error",
+)
 
 
 def _set_active_quarantined():
@@ -153,15 +183,16 @@ def _set_active_quarantined():
 # ---------------------------------------------------------------------- #
 # frame codec (outer framing only; payload is the reference wire format)
 
-def encode_frame(epoch: int, seq: int, ack: int, payload: bytes | None) -> bytes:
+def encode_frame(epoch: int, seq: int, ack: int, payload: bytes | None,
+                 extra_flags: int = 0) -> bytes:
     body = Encoder()
     body.append_uint32(epoch)
     body.append_uint53(seq)
     body.append_uint53(ack)
     if payload is None:
-        body.append_byte(0)
+        body.append_byte(extra_flags)
     else:
-        body.append_byte(FLAG_PAYLOAD)
+        body.append_byte(FLAG_PAYLOAD | extra_flags)
         body.append_prefixed_bytes(payload)
     encoder = Encoder()
     encoder.append_byte(FRAME_TYPE)
@@ -195,7 +226,10 @@ def decode_frame(data) -> dict:
         raise
     except (ValueError, TypeError, IndexError) as exc:
         raise SyncFrameError(f"malformed session frame: {exc}") from exc
-    return {"epoch": epoch, "seq": seq, "ack": ack, "payload": payload}
+    return {
+        "epoch": epoch, "seq": seq, "ack": ack, "flags": flags,
+        "payload": payload,
+    }
 
 
 # ---------------------------------------------------------------------- #
@@ -208,6 +242,7 @@ class BackendDriver:
 
     def __init__(self, backend):
         self.backend = backend
+        self._v2_index = None  # lazily built, incrementally refreshed
 
     def generate(self, state):
         return generate_sync_message(self.backend, state)
@@ -215,6 +250,19 @@ class BackendDriver:
     def receive(self, state, payload):
         self.backend, state, patch = receive_sync_message(
             self.backend, state, payload
+        )
+        return state, patch
+
+    def _index(self):
+        self._v2_index = index_for_backend(self.backend, self._v2_index)
+        return self._v2_index
+
+    def generate_v2(self, state):
+        return generate_sync_message_v2(self.backend, state, self._index())
+
+    def receive_v2(self, state, payload):
+        self.backend, state, patch = receive_sync_message_v2(
+            self.backend, state, self._index(), payload
         )
         return state, patch
 
@@ -243,6 +291,19 @@ class FarmDriver:
         )
         return state, patch
 
+    def generate_v2(self, state):
+        ((state, msg),) = self.sync_farm.generate_messages(
+            [(self.doc, state)], protocols=["v2"]
+        )
+        return state, msg
+
+    def receive_v2(self, state, payload):
+        decode_sync_message_v2(payload)  # raises, farm state untouched
+        ((state, patch),) = self.sync_farm.receive_messages(
+            [(self.doc, state, payload)], protocols=["v2"]
+        )
+        return state, patch
+
     def heads(self):
         return self.sync_farm.farm.get_heads(self.doc)
 
@@ -259,6 +320,7 @@ class SessionConfig:
     backoff_base: float = 0.5     # first retry's backoff cap
     backoff_cap: float = 10.0     # backoff growth ceiling
     watchdog_rounds: int = 5      # K no-progress rounds before escalation
+    enable_v2: bool = False       # advertise range-based reconciliation
 
 
 def _default_clock():
@@ -311,15 +373,78 @@ class SyncSession:
         self._acked_payload = None
         self._acked_rx_mark = -1
         self._payloads_applied = 0
+        # v2 negotiation: we advertise when the config opts in AND the
+        # driver can actually run both halves of the v2 protocol; the peer
+        # advertises via FLAG_V2 on its frames. v2_fallback latches a
+        # mid-session downgrade (v2 exchange errored) — permanent for this
+        # session incarnation, cleared only by a peer restart.
+        self.v2_local = bool(
+            self.config.enable_v2
+            and hasattr(driver, "generate_v2")
+            and hasattr(driver, "receive_v2")
+        )
+        self.peer_v2 = False
+        self.v2_fallback = False
         self.stats = {
             "retransmits": 0, "dup_dropped": 0, "timeouts": 0,
             "backoff_ms": 0.0, "peer_restarts": 0, "shed": 0,
             "stalls": 0, "escalations": 0, "resets": 0, "suppressed": 0,
+            "v2_negotiated": 0, "v2_fallbacks": 0,
         }
         self._wd_heads = None
         self._wd_shared = None
         self._wd_rounds = 0
         self._wd_stage = 0
+
+    # -------------------------------------------------------------- #
+    # protocol negotiation (sync v2)
+
+    @property
+    def v2_active(self) -> bool:
+        """True when this session generates v2 messages: both sides have
+        advertised the capability and no fallback has latched. Inbound
+        dispatch is by payload type byte regardless, so flipping mid-flight
+        is safe."""
+        return self.v2_local and self.peer_v2 and not self.v2_fallback
+
+    def _flags_out(self) -> int:
+        return FLAG_V2 if (self.v2_local and not self.v2_fallback) else 0
+
+    def _note_peer_flags(self, flags: int):
+        """Tracks the peer's advertised capability from every frame. A
+        frame WITHOUT the flag from a previously-v2 peer downgrades us too
+        (the peer latched its own fallback); the symmetric drop is what
+        terminates a one-sided fallback instead of leaving us feeding v2
+        frames to a peer that now rejects them."""
+        peer_v2 = bool(flags & FLAG_V2)
+        if peer_v2 == self.peer_v2:
+            return
+        was_active = self.v2_active
+        self.peer_v2 = peer_v2
+        if not was_active and self.v2_active:
+            _M_V2_NEGOTIATED.inc()
+            self.stats["v2_negotiated"] += 1
+            if _FLIGHT.enabled:
+                _FLIGHT.record("v2.negotiated", t=self.clock(),
+                               epoch=self.epoch, peer_epoch=self.peer_epoch)
+
+    def _v2_fall_back(self, where: str, cause):
+        """Latches the mid-session downgrade to v1: counted, flight-evented
+        (record + trigger — a fallback is a postmortem-worthy anomaly), v2
+        descent state dropped so the Bloom machinery starts clean."""
+        if self.v2_fallback:
+            return
+        self.v2_fallback = True
+        _M_V2_FALLBACKS.inc()
+        self.stats["v2_fallbacks"] += 1
+        self._acked_payload = None  # the v1 restart must regenerate freely
+        if _FLIGHT.enabled:
+            _FLIGHT.record("v2.fallback", t=self.clock(), epoch=self.epoch,
+                           where=where, cause=str(cause))
+            _FLIGHT.trigger("v2.fallback", t=self.clock(), epoch=self.epoch)
+        self.state = {
+            k: v for k, v in self.state.items() if not k.startswith("v2")
+        }
 
     # -------------------------------------------------------------- #
     # send half
@@ -332,8 +457,20 @@ class SyncSession:
         ready = self.poll_begin()
         if ready is not NEEDS_GENERATE:
             return ready
-        state, payload = self.driver.generate(self.state)
+        state, payload = self._generate_dispatch(self.state)
         return self.poll_commit(state, payload)
+
+    def _generate_dispatch(self, state):
+        """Runs the negotiated protocol's generate; a v2 generate error
+        falls back to v1 (counted + flight-evented) rather than killing
+        the channel."""
+        if self.v2_active:
+            try:
+                return self.driver.generate_v2(state)
+            except SyncProtocolError as exc:
+                self._v2_fall_back("generate", exc)
+                state = self.state  # _v2_fall_back stripped the v2 keys
+        return self.driver.generate(state)
 
     def poll_begin(self):
         """The pre-generate half of ``poll``: quarantine shed, owed acks
@@ -374,7 +511,7 @@ class SyncSession:
             # re-frame so the retransmission carries the current ack
             return encode_frame(
                 self.epoch, self.pending["seq"], self.last_seen,
-                self.pending["payload"],
+                self.pending["payload"], self._flags_out(),
             )
         return NEEDS_GENERATE
 
@@ -408,11 +545,13 @@ class SyncSession:
             "rx_mark": self._payloads_applied,
         }
         self.ack_owed = False
-        return encode_frame(self.epoch, self.seq_out, self.last_seen, payload)
+        return encode_frame(self.epoch, self.seq_out, self.last_seen, payload,
+                            self._flags_out())
 
     def _ack_frame(self) -> bytes:
         self.ack_owed = False
-        return encode_frame(self.epoch, 0, self.last_seen, None)
+        return encode_frame(self.epoch, 0, self.last_seen, None,
+                            self._flags_out())
 
     def _backoff(self, attempt: int) -> float:
         """Full jitter: uniform in [0, min(cap, base * 2^(attempt-1)))."""
@@ -437,8 +576,23 @@ class SyncSession:
         # apply BEFORE advancing the seq watermark: a payload the inner
         # protocol rejects (corrupt/inapplicable) must not be acked, so the
         # peer's intact retransmission gets a clean retry
-        state, patch = self.driver.receive(self.state, pre["payload"])
+        state, patch = self._receive_dispatch(self.state, pre["payload"])
         return self.commit(pre, state, patch)
+
+    def _receive_dispatch(self, state, payload):
+        """Routes an inbound payload by its leading type byte. A v2
+        payload that errors latches the fallback and is ACKED with state
+        unchanged: withholding the ack would make the peer retransmit the
+        same poisoned frame until the retry budget quarantined the channel.
+        v1 payload errors keep the withhold-ack semantics — their
+        retransmission path is how transient corruption heals."""
+        if self.v2_local and payload and payload[0] == MESSAGE_TYPE_SYNC_V2:
+            try:
+                return self.driver.receive_v2(state, payload)
+            except SyncProtocolError as exc:
+                self._v2_fall_back("receive", exc)
+                return self.state, None
+        return self.driver.receive(state, payload)
 
     def begin(self, frame_bytes):
         """The envelope half of ``handle``: decodes and validates the
@@ -464,6 +618,7 @@ class SyncSession:
             if self.peer_epoch is not None:
                 self._on_peer_restart()
             self.peer_epoch = frame["epoch"]
+        self._note_peer_flags(frame["flags"])
         if self.pending is not None and frame["ack"] >= self.pending["seq"]:
             self._acked_payload = self.pending["payload"]
             self._acked_rx_mark = self.pending["rx_mark"]
@@ -506,11 +661,18 @@ class SyncSession:
         self.last_seen = 0
         self.pending = None  # addressed to the old incarnation; regenerate
         self._acked_payload = None  # the new incarnation acked nothing
-        self.state = dict(
-            self.state,
-            theirHeads=None, theirHave=None, theirNeed=None,
-            lastSentHeads=[], sentHashes={},
-        )
+        self.state = {
+            k: v for k, v in dict(
+                self.state,
+                theirHeads=None, theirHave=None, theirNeed=None,
+                lastSentHeads=[], sentHashes={},
+            ).items()
+            if not k.startswith("v2")  # in-flight descents die with the peer
+        }
+        # the new incarnation re-negotiates from scratch (it may have come
+        # back without v2, or healthy enough to retry after our fallback)
+        self.peer_v2 = False
+        self.v2_fallback = False
         self._wd_rounds = 0
         self._wd_stage = 0
 
@@ -544,6 +706,22 @@ class SyncSession:
             _FLIGHT.record("watchdog.stall", t=self.clock(),
                            epoch=self.epoch, stage=self._wd_stage)
         self._acked_payload = None  # escalations must retransmit freely
+        if self.v2_active:
+            # v2 has no Bloom state to rebuild and no probabilistic
+            # failure mode to escalate past — in practice this branch
+            # should be unreachable (that's the point of v2). If it ever
+            # fires, drop the in-flight descent so the next generate
+            # re-probes the full range from current heads.
+            if _FLIGHT.enabled:
+                _FLIGHT.record("watchdog.escalate", t=self.clock(),
+                               epoch=self.epoch, action="v2_reprobe")
+            self.state = {
+                k: v for k, v in dict(
+                    self.state, lastSentHeads=[], sentHashes={},
+                ).items()
+                if not k.startswith("v2")
+            }
+            return
         if self._wd_stage == 0:
             # stage 1 — rebuild the Bloom exchange: clearing sentHashes and
             # lastSentHeads makes the next generate resend its filter and
@@ -624,7 +802,9 @@ class SyncSession:
 
     def save(self) -> bytes:
         """Durable snapshot: the inner state's sharedHeads plus the session
-        extension (epoch and seq/ack watermarks). In-flight frames are
+        extension (epoch and seq/ack watermarks, and the watchdog's
+        escalation ladder — without it a restart silently re-armed a
+        stalled channel's stall counters from zero). In-flight frames are
         deliberately not persisted — after restore the peer's
         retransmissions and our regenerated frames re-fill the channel."""
         return encode_sync_state(self.state, session={
@@ -632,13 +812,20 @@ class SyncSession:
             "seqOut": self.seq_out,
             "lastSeen": self.last_seen,
             "peerEpoch": self.peer_epoch,
+            "wdRounds": self._wd_rounds,
+            "wdStage": self._wd_stage,
+            "wdStalls": self.stats["stalls"],
+            "wdEscalations": self.stats["escalations"],
+            "wdResets": self.stats["resets"],
         })
 
     @classmethod
     def restore(cls, blob, driver, *, clock=None, rng=None, config=None):
         """Resumes a channel from ``save()`` output. Pre-extension blobs
         (plain ``encode_sync_state``) restore too — the session then starts
-        with a fresh epoch, which the peer handles as a restart."""
+        with a fresh epoch, which the peer handles as a restart. Blobs
+        written before the watchdog tail existed restore with the
+        escalation ladder at rest."""
         state = decode_sync_state(blob)
         session = state.pop("session", None)
         restored = cls(driver, clock=clock, rng=rng, config=config, state=state)
@@ -647,4 +834,9 @@ class SyncSession:
             restored.seq_out = session["seqOut"]
             restored.last_seen = session["lastSeen"]
             restored.peer_epoch = session["peerEpoch"]
+            restored._wd_rounds = session.get("wdRounds", 0)
+            restored._wd_stage = session.get("wdStage", 0)
+            restored.stats["stalls"] = session.get("wdStalls", 0)
+            restored.stats["escalations"] = session.get("wdEscalations", 0)
+            restored.stats["resets"] = session.get("wdResets", 0)
         return restored

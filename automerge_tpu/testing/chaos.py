@@ -61,6 +61,7 @@ class ChaosConfig:
     delay: float = 0.0       # frame held for extra latency
     min_delay: float = 0.05  # extra latency range when delayed
     max_delay: float = 1.5
+    base_delay: float = 0.0  # fixed latency every frame pays (per-link skew)
 
     @classmethod
     def lossy(cls, p: float) -> "ChaosConfig":
@@ -126,7 +127,7 @@ class ChaosLink:
             elif roll < cfg.corrupt + cfg.truncate and len(frame) > 1:
                 damaged = frame[: rng.randrange(1, len(frame))]
                 self.stats.frames_truncated += 1
-            at = self.clock()
+            at = self.clock() + cfg.base_delay
             if rng.random() < cfg.delay:
                 at += rng.uniform(cfg.min_delay, cfg.max_delay)
                 self.stats.frames_delayed += 1
@@ -207,6 +208,26 @@ class ChaosNetwork:
     def heal(self, a, b) -> None:
         self.link(a, b).partitioned = False
         self.link(b, a).partitioned = False
+
+    def partition_one_way(self, src, dst) -> None:
+        """Asymmetric partition: ``src -> dst`` drops while ``dst -> src``
+        keeps flowing — the half-open failure real networks produce (dead
+        uplink, live downlink) that a symmetric partition can't model:
+        one side keeps receiving and acking while its own frames vanish."""
+        self.link(src, dst).partitioned = True
+
+    def heal_one_way(self, src, dst) -> None:
+        self.link(src, dst).partitioned = False
+
+    def set_latency(self, src, dst, base: float) -> None:
+        """Per-link latency skew: every ``src -> dst`` frame arrives at
+        least ``base`` simulated seconds late, on top of the probabilistic
+        delay. Skewing the two directions differently exercises the
+        stop-and-wait timers against asymmetric RTT halves."""
+        from dataclasses import replace
+
+        link = self.link(src, dst)
+        link.config = replace(link.config, base_delay=base)
 
     def drop_in_flight(self, peer) -> int:
         """Clears every queue to or from ``peer`` (the transport half of a

@@ -1,0 +1,208 @@
+"""Range-fingerprint index for sync v2: batched XOR reductions on device.
+
+The v2 reconciliation driver (automerge_tpu/sync_v2.py) compares change-hash
+sets range-by-range using XOR-of-hash fingerprints. Per document the
+arithmetic is trivial; what the farm needs is the batch shape: a serving
+sweep holds hundreds of live v2 channels, and EVERY channel's fingerprint
+queries for the round — inbound-range checks, median splits, fresh probes —
+must resolve as ONE device dispatch, not one per channel (the columnar
+playbook of the Bloom kernels in sync_batch.py).
+
+``FingerprintIndex`` keeps one sorted hash array per document on the host
+(incrementally extended on every commit, rebuildable from the amstore hash
+graph after a restart via ``rebuild_from_store``) and packs the queried
+documents into a pow2-bucketed ``[B, E, 8]`` uint32 tensor; the
+``sync.fingerprint_ranges`` program — registered with the amprof
+observatory like every compiled program in this package — masks each row
+to its [start, end) span and XOR-reduces along the entry axis. Counts come
+from host-side bisection (they are index arithmetic, not data reduction).
+
+Fingerprints are canonical: XOR over 256-bit hash integers, returned as
+64-char hex, bit-identical to the host ``HashIndex`` prefix-XOR path —
+asserted by tests/test_sync_v2.py so the two implementations can never
+drift.
+"""
+from __future__ import annotations
+
+from bisect import bisect_left, insort
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import decode_change_meta_cached
+from ..errors import SyncProtocolError
+from ..sync import HASH_SIZE
+
+#: one SHA-256 hash as big-endian uint32 words
+HASH_WORDS = HASH_SIZE // 4
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (min 1): the shape-bucket grid for the
+    batched reduction, so every sweep's (batch, entries) pair lands on a
+    few compiled programs instead of one per distinct shape."""
+    return 1 << (max(n, 1) - 1).bit_length()
+
+
+from .jitprof import profiled_jit
+
+
+@profiled_jit("sync.fingerprint_ranges")
+def fingerprint_ranges_kernel(words, starts, ends):
+    """XOR-reduces each row's [start, end) span: words [B, E, 8] uint32,
+    starts/ends [B] int32 -> [B, 8] uint32. Padded rows (start == end == 0)
+    reduce to zero."""
+    idx = jnp.arange(words.shape[1], dtype=jnp.int32)[None, :]
+    mask = (idx >= starts[:, None]) & (idx < ends[:, None])
+    masked = jnp.where(mask[:, :, None], words, jnp.uint32(0))
+    return jax.lax.reduce(
+        masked, jnp.uint32(0), jax.lax.bitwise_xor, dimensions=(1,)
+    )
+
+
+def _hash_words(h: str) -> list[int]:
+    return [int(h[8 * k: 8 * k + 8], 16) for k in range(HASH_WORDS)]
+
+
+class _DocIndex:
+    """One document's sorted hash array plus its packed device words."""
+
+    __slots__ = ("hashes", "members", "words", "dirty")
+
+    def __init__(self):
+        self.hashes: list[str] = []
+        self.members: set[str] = set()
+        self.words: np.ndarray | None = None
+        self.dirty = True
+
+    def insert(self, h: str) -> bool:
+        if h in self.members:
+            return False
+        if len(h) != 2 * HASH_SIZE:
+            raise SyncProtocolError(f"not a 256-bit hash: {h!r}")
+        self.members.add(h)
+        insort(self.hashes, h)
+        self.dirty = True
+        return True
+
+    def packed(self, width: int) -> np.ndarray:
+        if self.dirty or self.words is None or self.words.shape[0] < width:
+            words = np.zeros((width, HASH_WORDS), np.uint32)
+            for e, h in enumerate(self.hashes):
+                words[e] = _hash_words(h)
+            self.words = words
+            self.dirty = False
+        return self.words[:width]
+
+
+class _DocView:
+    """Host-side set view of one document (the ``view`` protocol the v2
+    driver's plan/receive phases consume: count/items/contains plus
+    incremental insert)."""
+
+    __slots__ = ("_doc",)
+
+    def __init__(self, doc: _DocIndex):
+        self._doc = doc
+
+    def __len__(self) -> int:
+        return len(self._doc.hashes)
+
+    def contains(self, h: str) -> bool:
+        return h in self._doc.members
+
+    def insert_many(self, hashes) -> None:
+        for h in hashes:
+            self._doc.insert(h)
+
+    def count(self, lo: str, hi: str) -> int:
+        hashes = self._doc.hashes
+        return bisect_left(hashes, hi) - bisect_left(hashes, lo)
+
+    def items(self, lo: str, hi: str) -> list[str]:
+        hashes = self._doc.hashes
+        return hashes[bisect_left(hashes, lo):bisect_left(hashes, hi)]
+
+
+class FingerprintIndex:
+    """Per-document range-fingerprint indexes with batched resolution.
+
+    Lifecycle: ``note_commit`` extends a document's set incrementally on
+    every applied change; ``sync_doc`` reconciles against an authoritative
+    hash list (cheap no-op when counts agree — change sets only grow);
+    ``rebuild_from_store`` re-hydrates every document from a ShardStore's
+    persisted hash graph after a restart, so the index survives crashes
+    without a full history walk."""
+
+    def __init__(self):
+        self._docs: dict[int, _DocIndex] = {}
+
+    def _doc(self, d: int) -> _DocIndex:
+        doc = self._docs.get(d)
+        if doc is None:
+            doc = self._docs[d] = _DocIndex()
+        return doc
+
+    def view(self, d: int) -> _DocView:
+        return _DocView(self._doc(d))
+
+    def note_commit(self, d: int, hashes) -> None:
+        """Incremental update: the hashes of changes just committed."""
+        doc = self._doc(d)
+        for h in hashes:
+            doc.insert(h)
+
+    def sync_doc(self, d: int, hashes) -> None:
+        """Reconciles document ``d`` against an authoritative hash list."""
+        doc = self._doc(d)
+        if len(hashes) != len(doc.hashes):
+            for h in hashes:
+                doc.insert(h)
+
+    def sync_from_farm(self, farm, d: int) -> None:
+        """Refreshes document ``d`` from a TpuDocFarm's change graph."""
+        self.sync_doc(d, [
+            decode_change_meta_cached(c)["hash"]
+            for c in farm.get_changes(d, [])
+        ])
+
+    def rebuild_from_store(self, store) -> None:
+        """Re-hydrates from the amstore hash graph (ShardStore's per-doc
+        footer hash lists) — the restart path: the store already proved
+        these hashes against its checksummed segments."""
+        for d, hashes in store.footer_hashes.items():
+            self.sync_doc(int(d), hashes)
+
+    # -------------------------------------------------------------- #
+
+    def fingerprint_ranges(self, queries) -> list[tuple[int, str]]:
+        """Resolves [(doc, lo, hi)] -> [(count, xor_hex)] in query order.
+
+        ALL queries reduce in one pow2-bucketed device dispatch: the
+        batch axis is the query list (documents repeat freely), the entry
+        axis is the largest queried document padded to a power of two.
+        An empty query list dispatches nothing."""
+        if not queries:
+            return []
+        spans = []
+        for d, lo, hi in queries:
+            doc = self._doc(d)
+            i = bisect_left(doc.hashes, lo)
+            j = bisect_left(doc.hashes, hi)
+            spans.append((doc, i, j))
+        width = _pow2(max((len(doc.hashes) for doc, _, _ in spans), default=1))
+        batch = _pow2(len(queries))
+        words = np.zeros((batch, width, HASH_WORDS), np.uint32)
+        starts = np.zeros(batch, np.int32)
+        ends = np.zeros(batch, np.int32)
+        for b, (doc, i, j) in enumerate(spans):
+            words[b] = doc.packed(width)
+            starts[b] = i
+            ends[b] = j
+        fp_words = np.asarray(fingerprint_ranges_kernel(words, starts, ends))
+        out = []
+        for b, (_doc, i, j) in enumerate(spans):
+            fp = "".join(format(int(w), "08x") for w in fp_words[b])
+            out.append((j - i, fp))
+        return out

@@ -1285,6 +1285,8 @@ def bench_chaos(rounds, ops_per_round, loss, seed=0):
         "dup_dropped": snap["sync.session.dup_dropped"]["value"],
         "frames_rejected": snap["sync.session.frames_rejected"]["value"],
         "watchdog_stalls": snap["sync.watchdog.stalls"]["value"],
+        "watchdog_escalations": snap["sync.watchdog.escalations"]["value"],
+        "watchdog_resets": snap["sync.watchdog.resets"]["value"],
         "bytes_sent": bytes_sent,
         "bytes_delivered": bytes_delivered,
     }
@@ -1311,10 +1313,365 @@ def _chaos_main(loss):
         "dup_dropped": chaotic["dup_dropped"],
         "frames_rejected": chaotic["frames_rejected"],
         "watchdog_stalls": chaotic["watchdog_stalls"],
+        "watchdog_escalations": chaotic["watchdog_escalations"],
+        "watchdog_resets": chaotic["watchdog_resets"],
         "wire_overhead": round(
             chaotic["bytes_sent"] / max(clean["bytes_sent"], 1), 2
         ),
     }))
+
+
+class _SetPeer:
+    """Synthetic v2 reconciliation peer for the at-scale round-trip count:
+    a 'change' is just its hash (get_change returns the hex bytes,
+    'applying' inserts it into the index), so the measurement isolates the
+    range-descent structure and fingerprint arithmetic from the CRDT apply
+    path, which costs the same under either protocol. Heads are modelled
+    as the running XOR of the member set — equal exactly when the sets
+    are (the quiescence condition the real driver gets from backend
+    heads)."""
+
+    def __init__(self, hashes):
+        from automerge_tpu.sync import init_sync_state
+        from automerge_tpu.sync_v2 import HashIndex
+
+        self.index = HashIndex()
+        self.index.insert_many(sorted(hashes))  # sorted: insort appends
+        self.acc = 0
+        for h in hashes:
+            self.acc ^= int(h, 16)
+        self.state = init_sync_state()
+        self.bytes_sent = 0
+
+    def head(self):
+        return format(self.acc, "064x")
+
+    def generate(self):
+        from automerge_tpu.sync_v2 import finish_generate_v2, plan_generate_v2
+
+        our_heads = [self.head()]
+        plan, queries = plan_generate_v2(self.state, self.index, our_heads)
+        fps = self.index.fingerprint_many(queries)
+        self.state, msg = finish_generate_v2(
+            self.state, plan, fps,
+            lambda h: h.encode() if self.index.contains(h) else None,
+            our_heads, [],
+        )
+        if msg is not None:
+            self.bytes_sent += len(msg)
+        return msg
+
+    def receive(self, data):
+        from automerge_tpu.sync_v2 import decode_sync_message_v2, post_receive_v2
+
+        msg = decode_sync_message_v2(data)
+        before = [self.head()]
+        for change in msg["changes"]:
+            h = change.decode()
+            if self.index.insert(h):
+                self.acc ^= int(h, 16)
+        after = [self.head()]
+        self.state = post_receive_v2(
+            self.state, msg, before, after,
+            lambda h, me=after[0]: h == me, self.index,
+        )
+
+
+def bench_sync2_reconcile(n, seed=0):
+    """Round trips and host cost for v2-reconciling an n-change divergent
+    history: the peers share 90% of the set and each holds a private 5%.
+    The deterministic bound is 2*log2(n) round trips — no Bloom
+    false-positive tail, so there is nothing for a watchdog to break."""
+    import hashlib
+    import math
+
+    universe = [
+        hashlib.sha256(f"{seed}:{i}".encode()).hexdigest() for i in range(n)
+    ]
+    div = max(n // 20, 1)
+    a = _SetPeer(universe[: n - div])        # missing b's tail
+    b = _SetPeer(universe[:n - 2 * div] + universe[n - div:])
+
+    start = time.perf_counter()
+    trips = 0
+    for _ in range(96):
+        ma, mb = a.generate(), b.generate()
+        if ma is None and mb is None:
+            break
+        trips += 1
+        if ma is not None:
+            b.receive(ma)
+        if mb is not None:
+            a.receive(mb)
+    elapsed = time.perf_counter() - start
+    bound = 2 * math.log2(max(n, 2))
+    return {
+        "changes": n,
+        "divergent": 2 * div,
+        "round_trips": trips,
+        "bound": round(bound, 1),
+        "within_bound": trips <= bound,
+        "converged": a.head() == b.head() and len(a.index) == len(b.index),
+        "elapsed_s": round(elapsed, 3),
+        "bytes": a.bytes_sent + b.bytes_sent,
+    }
+
+
+def bench_sync2_soak(v2, n_changes, ops_per_round, loss, seed=0):
+    """The acceptance soak: one peer holds the history with its v1
+    ``sentHashes`` belief poisoned (every change marked already-sent — the
+    deterministic stand-in for a Bloom false positive wrongly withholding
+    changes). Under v1 only the watchdog ladder can break the stall, so
+    the run records watchdog events; under the SAME poisoned state v2
+    converges with the ladder untouched — range reconciliation never
+    consults ``sentHashes``."""
+    import random
+
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.columnar import decode_change_meta_cached
+    from automerge_tpu.sync_session import (
+        BackendDriver, SessionConfig, SyncSession,
+    )
+    from automerge_tpu.testing.chaos import (
+        ChaosConfig, ChaosHarness, ChaosNetwork, ManualClock,
+    )
+
+    clock = ManualClock()
+    network = ChaosNetwork(random.Random(seed), clock, ChaosConfig.lossy(loss))
+    harness = ChaosHarness(network, clock)
+    da, db = BackendDriver(Backend.init()), BackendDriver(Backend.init())
+    config = SessionConfig(enable_v2=v2)
+    sa = SyncSession(da, clock=clock, rng=random.Random(seed + 1), config=config)
+    sb = SyncSession(db, clock=clock, rng=random.Random(seed + 2), config=config)
+    harness.add_session("a", "b", sa)
+    harness.add_session("b", "a", sb)
+    # Phase 1: establish a shared non-empty history. Both the initial
+    # handshake's peer-restart reset and v1's empty-peer reset
+    # (receive_sync_message clears sentHashes when the peer's heads are
+    # empty) would legitimately wash the poison out, so the stall has to
+    # be staged against an in-sync, non-empty peer — exactly where real
+    # Bloom false positives bite.
+    stream = _make_change_stream(n_changes + 2, ops_per_round, seed)
+    backend = da.backend
+    for buf in stream[:2]:
+        backend, _ = Backend.apply_changes(backend, [buf])
+    da.backend = backend
+    assert harness.run_until(lambda: da.heads() == db.heads(),
+                             max_time=600.0)
+
+    # Phase 2: new local history, with every change marked already-sent.
+    for buf in stream[2:]:
+        backend, _ = Backend.apply_changes(backend, [buf])
+    da.backend = backend
+    hashes = [
+        decode_change_meta_cached(c)["hash"]
+        for c in Backend.get_changes(backend, [])
+    ]
+    sa.state = dict(sa.state, sentHashes={h: True for h in hashes})
+
+    start = time.perf_counter()
+    converged = harness.run_until(
+        lambda: da.heads() == db.heads(), max_time=7200.0
+    )
+    elapsed = time.perf_counter() - start
+    frames = sum(s["frames_sent"] for s in network.stats().values())
+    stalls = sa.stats["stalls"] + sb.stats["stalls"]
+    escalations = sa.stats["escalations"] + sb.stats["escalations"]
+    resets = sa.stats["resets"] + sb.stats["resets"]
+    total_ops = n_changes * ops_per_round
+    return {
+        "protocol": "v2" if v2 else "v1",
+        "converged": converged,
+        "v2_active": bool(sa.v2_active and sb.v2_active),
+        "watchdog": {"stalls": stalls, "escalations": escalations,
+                     "resets": resets},
+        "watchdog_events": stalls + escalations + resets,
+        "frames": frames,
+        "simulated_s": round(clock.now(), 2),
+        "elapsed_s": round(elapsed, 3),
+        "ops_per_sec": round(total_ops / elapsed) if elapsed else 0,
+    }
+
+
+def bench_sync2_interop(seed=0):
+    """v1<->v2 interop: a v2-capable session facing a v1 peer must produce
+    EXACTLY today's v1 transcript — same inner payload bytes in the same
+    order (the capability flag rides the session flags byte, invisible to
+    the inner protocol)."""
+    import random
+
+    from automerge_tpu import backend as Backend
+    from automerge_tpu.sync_session import (
+        BackendDriver, SessionConfig, SyncSession, decode_frame,
+    )
+    from automerge_tpu.testing.chaos import ManualClock
+
+    def transcript(v2a):
+        backend = Backend.init()
+        for buf in _make_change_stream(6, 8, seed):
+            backend, _ = Backend.apply_changes(backend, [buf])
+        clock = ManualClock()
+        sa = SyncSession(BackendDriver(backend), clock=clock,
+                         rng=random.Random(seed + 3),
+                         config=SessionConfig(enable_v2=v2a))
+        sb = SyncSession(BackendDriver(Backend.init()), clock=clock,
+                         rng=random.Random(seed + 4))
+        payloads = []
+        for _ in range(60):
+            fa, fb = sa.poll(), sb.poll()
+            for frame, receiver in ((fa, sb), (fb, sa)):
+                if frame is not None:
+                    payloads.append(decode_frame(frame)["payload"])
+                    receiver.handle(frame)
+            if fa is None and fb is None:
+                if sa.driver.heads() == sb.driver.heads():
+                    break
+            clock.advance(0.05 if (fa or fb) else 0.26)
+        return payloads, sa.driver.heads() == sb.driver.heads()
+
+    ref, ok_ref = transcript(False)
+    mixed, ok_mixed = transcript(True)
+    return {
+        "byte_for_byte": ref == mixed,
+        "converged": bool(ok_ref and ok_mixed),
+        "frames": len(ref),
+    }
+
+
+def bench_sync2_farm(num_docs=4, sweeps=12):
+    """The farm dispatch pin: a generate sweep over N live v2 channels
+    resolves ALL fingerprint queries as ONE ``sync.fingerprint_ranges``
+    dispatch (observatory program count), not one per channel."""
+    from automerge_tpu.columnar import encode_change
+    from automerge_tpu.obs.prof import enabled_observatory, get_observatory
+    from automerge_tpu.tpu.farm import TpuDocFarm
+    from automerge_tpu.tpu.sync_farm import SyncFarm
+
+    def edit(farm, d, actor, keys):
+        buf = encode_change({
+            "actor": actor, "seq": 1, "startOp": 1, "time": 0,
+            "deps": sorted(farm.get_heads(d)),
+            "ops": [{"action": "set", "obj": "_root", "key": k,
+                     "datatype": "uint", "value": v, "pred": []}
+                    for v, k in enumerate(keys)],
+        })
+        per_doc = [[] for _ in range(farm.num_docs)]
+        per_doc[d] = [buf]
+        farm.apply_changes(per_doc)
+
+    fa, fb = TpuDocFarm(num_docs, capacity=256), TpuDocFarm(num_docs, capacity=256)
+    for d in range(num_docs):
+        edit(fa, d, "aaaaaaaa", [f"a{d}", f"x{d}"])
+        edit(fb, d, "bbbbbbbb", [f"b{d}"])
+    sa, sb = SyncFarm(fa), SyncFarm(fb)
+    a_states = [SyncFarm.init_state() for _ in range(num_docs)]
+    b_states = [SyncFarm.init_state() for _ in range(num_docs)]
+    protocols = ["v2"] * num_docs
+
+    obs = get_observatory()
+    prog = obs.programs()["sync.fingerprint_ranges"]
+    generate_sweeps = 0
+    with enabled_observatory():
+        prog.reset()
+        for _ in range(sweeps):
+            quiet = True
+            for states_out, states_in, src, dst in (
+                (a_states, b_states, sa, sb),
+                (b_states, a_states, sb, sa),
+            ):
+                out = src.generate_messages(
+                    list(zip(range(num_docs), states_out)),
+                    protocols=protocols,
+                )
+                generate_sweeps += 1
+                states_out[:] = [s for s, _ in out]
+                sends = [(d, states_in[d], m)
+                         for d, (_, m) in enumerate(out) if m is not None]
+                if sends:
+                    quiet = False
+                    recv = dst.receive_messages(sends, protocols=protocols)
+                    for (d, _, _), (state, _p) in zip(sends, recv):
+                        states_in[d] = state
+            if quiet:
+                break
+        dispatches = prog.dispatches
+    converged = all(
+        fa.get_heads(d) == fb.get_heads(d) for d in range(num_docs)
+    )
+    return {
+        "docs": num_docs,
+        "generate_sweeps": generate_sweeps,
+        "fingerprint_dispatches": dispatches,
+        "one_dispatch_per_sweep": 0 < dispatches <= generate_sweeps,
+        "converged": converged,
+    }
+
+
+def _sync2_main(quick):
+    """`bench.py --sync2 [--quick]`: Bloom (v1) vs range reconciliation
+    (v2) — rounds + goodput — in one JSON line. Gates:
+
+    - v2 reconciles an n-change divergent history in <= 2*log2(n) round
+      trips (n = 1e5 full, BENCH_SYNC2_N to override);
+    - under a 30% chaos soak with the poisoned-`sentHashes` stall, the v1
+      run records >= 1 watchdog event while the v2 run records ZERO;
+    - the v1<->v2 interop pairing converges byte-for-byte with today's
+      v1 transcript;
+    - every farm generate sweep resolves ALL v2 channels' fingerprints as
+      ONE observatory-pinned device dispatch.
+
+    The full run writes SYNC_r01.json + a perf-ledger row (visible via
+    `python -m automerge_tpu.obs --ledger ledger.jsonl --diff -2 -1`)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    n = int(os.environ.get("BENCH_SYNC2_N", "4000" if quick else "100000"))
+    loss = float(os.environ.get("BENCH_SYNC2_LOSS", "0.3"))
+    soak_changes = int(os.environ.get("BENCH_SYNC2_SOAK_CHANGES", "48"))
+    soak_ops = int(os.environ.get("BENCH_OPS", "16"))
+
+    reconcile = bench_sync2_reconcile(n)
+    soak_v1 = bench_sync2_soak(False, soak_changes, soak_ops, loss)
+    soak_v2 = bench_sync2_soak(True, soak_changes, soak_ops, loss)
+    interop = bench_sync2_interop()
+    farm = bench_sync2_farm()
+
+    ok = (
+        reconcile["within_bound"] and reconcile["converged"]
+        and soak_v1["converged"] and soak_v1["watchdog_events"] >= 1
+        and soak_v2["converged"] and soak_v2["watchdog_events"] == 0
+        and soak_v2["v2_active"]
+        and interop["byte_for_byte"] and interop["converged"]
+        and farm["one_dispatch_per_sweep"] and farm["converged"]
+    )
+    out = {
+        "metric": "sync v2 range reconciliation (round trips at divergence)",
+        "value": reconcile["round_trips"],
+        "unit": "round trips",
+        "ok": ok,
+        "reconcile": reconcile,
+        "soak": {"loss": loss, "v1": soak_v1, "v2": soak_v2},
+        "interop": interop,
+        "farm": farm,
+    }
+    print(json.dumps(out))
+    if not quick:
+        _ledger_append({
+            "kind": "sync2",
+            "config": {"changes": n, "loss": loss,
+                       "soak_changes": soak_changes, "soak_ops": soak_ops},
+            "ops_per_sec": soak_v2["ops_per_sec"],
+            "phases": {"reconcile": reconcile["elapsed_s"],
+                       "soak_v1": soak_v1["elapsed_s"],
+                       "soak_v2": soak_v2["elapsed_s"]},
+            "round_trips": reconcile["round_trips"],
+            "bound": reconcile["bound"],
+            "v1_watchdog_events": soak_v1["watchdog_events"],
+            "v2_watchdog_events": soak_v2["watchdog_events"],
+            "ok": ok,
+        })
+        with open(os.path.join(_REPO, "SYNC_r01.json"), "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    sys.exit(0 if ok else 1)
 
 
 def bench_store(num_docs, rounds, ops_per_round, seed=0):
@@ -1691,6 +2048,8 @@ if __name__ == "__main__":
         _gate_main()
     elif "--store" in sys.argv:
         _store_main(quick="--quick" in sys.argv)
+    elif "--sync2" in sys.argv:
+        _sync2_main(quick="--quick" in sys.argv)
     elif "--quick" in sys.argv:
         _quick_main()
     elif "--faults" in sys.argv:

@@ -1,0 +1,15 @@
+"""AM404 clean fixture: every v2 wire-codec raise is a taxonomy class."""
+# amlint: v2-wire-codec
+from automerge_tpu.errors import EncodeError, SyncProtocolError
+
+
+def decode_frame_v2(buf):
+    if not buf:
+        raise SyncProtocolError("empty v2 frame")
+    return buf[1:]
+
+
+def encode_range(lo, hi):
+    if lo >= hi:
+        raise EncodeError("range bounds must satisfy lo < hi")
+    return (lo, hi)

@@ -1,0 +1,8 @@
+"""AM404 suppressed fixture: a deliberate internal-invariant raise."""
+# amlint: v2-wire-codec
+
+
+def fingerprint_width(n):
+    if n < 0:
+        raise AssertionError("caller bug, not wire input")  # amlint: disable=AM404 — internal invariant, unreachable from decoded frames
+    return 1 << n

@@ -1,0 +1,10 @@
+"""AM404 violating fixture: v2 wire-codec raises outside the taxonomy."""
+# amlint: v2-wire-codec
+
+
+def decode_frame_v2(buf):
+    if not buf:
+        raise RuntimeError("empty v2 frame")
+    if buf[0] != 0x45:
+        raise LookupError("wrong message type byte")
+    return buf[1:]

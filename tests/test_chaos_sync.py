@@ -13,7 +13,7 @@ import automerge_tpu as am
 from automerge_tpu import Frontend
 from automerge_tpu import backend as Backend
 from automerge_tpu.errors import SyncProtocolError
-from automerge_tpu.sync_session import SessionConfig, SyncSession
+from automerge_tpu.sync_session import BackendDriver, SessionConfig, SyncSession
 from automerge_tpu.testing import faults
 from automerge_tpu.testing.chaos import (
     ChaosConfig,
@@ -183,6 +183,126 @@ class TestTwoPeerSoak:
         assert da.heads() == db.heads()
         assert canonical(da.doc) == canonical(db.doc)
         assert "during_a" in dict(da.doc) and "during_b" in dict(da.doc)
+
+
+# ---------------------------------------------------------------------- #
+# protocol pairings (ISSUE 18): v1<->v1, v1<->v2, v2<->v2 under 30% chaos
+
+
+def make_backend(actor, keys):
+    backend = Backend.init()
+    for i, key in enumerate(keys):
+        buf = am.encode_change({
+            "actor": actor, "seq": i + 1, "startOp": i + 1, "time": 0,
+            "deps": Backend.get_heads(backend),
+            "ops": [{"action": "set", "obj": "_root", "key": key,
+                     "datatype": "uint", "value": i, "pred": []}],
+        })
+        backend, _ = Backend.apply_changes(backend, [buf])
+    return backend
+
+
+def pairing_harness(seed, p, v2a, v2b):
+    clock, network, harness = make_harness(seed, p)
+    da = BackendDriver(make_backend("aaaaaaaa", [f"a{i}" for i in range(6)]))
+    db = BackendDriver(make_backend("bbbbbbbb", [f"b{i}" for i in range(6)]))
+    sa = SyncSession(da, clock=clock, rng=random.Random(seed * 31 + 1),
+                     config=SessionConfig(enable_v2=v2a))
+    sb = SyncSession(db, clock=clock, rng=random.Random(seed * 31 + 2),
+                     config=SessionConfig(enable_v2=v2b))
+    harness.add_session("a", "b", sa)
+    harness.add_session("b", "a", sb)
+    return clock, network, harness, da, db, sa, sb
+
+
+class TestProtocolPairingSoak:
+    """Sync v2 negotiation under fire: every capability pairing must
+    converge under 30% chaos. v2 only activates when BOTH sides advertise
+    it; the mixed pairings run byte-for-byte v1 (the v2 flag bit is
+    invisible to a peer that only tests FLAG_PAYLOAD)."""
+
+    @pytest.mark.parametrize("seed,v2a,v2b", [
+        (51, False, False), (52, False, True),
+        (53, True, False), (54, True, True),
+    ])
+    def test_pairing_converges_under_30pct_chaos(self, seed, v2a, v2b):
+        clock, _n, harness, da, db, sa, sb = pairing_harness(seed, 0.3, v2a, v2b)
+        assert harness.run_until(lambda: da.heads() == db.heads(),
+                                 max_time=900.0)
+        both = v2a and v2b
+        assert sa.v2_active == both and sb.v2_active == both
+        assert (sa.stats["v2_negotiated"] > 0) == both
+        assert sa.stats["v2_fallbacks"] == 0 and sb.stats["v2_fallbacks"] == 0
+
+    def test_v2_soak_never_trips_the_watchdog(self):
+        """The acceptance property: under the same 30% chaos, a v2<->v2
+        pairing converges with the watchdog ladder untouched — range
+        reconciliation has no false-positive stall mode to escalate out
+        of."""
+        clock, _n, harness, da, db, sa, sb = pairing_harness(55, 0.3, True, True)
+        assert harness.run_until(lambda: da.heads() == db.heads(),
+                                 max_time=900.0)
+        for s in (sa, sb):
+            assert s.stats["stalls"] == 0
+            assert s.stats["escalations"] == 0
+            assert s.stats["resets"] == 0
+
+
+class TestAsymmetricChaos:
+    """ISSUE 18 satellite: half-open partitions (one direction drops while
+    the other flows) and per-link latency skew."""
+
+    def test_one_way_partition_blocks_and_heals(self):
+        clock, network, harness = make_harness(61, 0.1)
+        da = DocDriver(edited_doc("aaaaaaaa", [("x", 1)]))
+        db = DocDriver(edited_doc("bbbbbbbb", [("y", 2)]))
+        sa, sb = pair_sessions(harness, clock, da, db, 61)
+        assert harness.run_until(lambda: da.heads() == db.heads(),
+                                 max_time=600.0)
+        # half-open: a's frames vanish, b's frames still arrive at a
+        network.partition_one_way("a", "b")
+        da.doc = am.change(da.doc, lambda d: d.__setitem__("during_a", 1))
+        db.doc = am.change(db.doc, lambda d: d.__setitem__("during_b", 2))
+        assert not harness.run_until(lambda: da.heads() == db.heads(),
+                                     max_time=30.0)
+        # the live direction kept delivering: a heard from b even while
+        # its own frames (including acks) were being eaten
+        assert network.link("b", "a").stats.frames_delivered > 0
+        network.heal_one_way("a", "b")
+        for _ in range(5):
+            sa.release()
+            sb.release()
+            if harness.run_until(lambda: da.heads() == db.heads(),
+                                 max_time=120.0):
+                break
+        assert da.heads() == db.heads()
+        assert canonical(da.doc) == canonical(db.doc)
+        assert "during_a" in dict(db.doc) and "during_b" in dict(da.doc)
+
+    @pytest.mark.parametrize("v2", [False, True])
+    def test_latency_skew_converges(self, v2):
+        """Asymmetric RTT halves: one direction pays 8x the latency of the
+        other. The stop-and-wait timers absorb the skew for both
+        protocols."""
+        clock, network, harness, da, db, sa, sb = pairing_harness(
+            62, 0.1, v2, v2
+        )
+        network.set_latency("a", "b", 0.4)
+        network.set_latency("b", "a", 0.05)
+        assert harness.run_until(lambda: da.heads() == db.heads(),
+                                 max_time=900.0)
+        assert sa.v2_active == v2 and sb.v2_active == v2
+
+    def test_skewed_link_applies_base_delay(self):
+        clock = ManualClock()
+        network = ChaosNetwork(random.Random(0), clock, ChaosConfig())
+        network.set_latency("a", "b", 0.3)
+        network.send("a", "b", b"frame")
+        assert network.deliver("b") == []          # still in flight
+        clock.advance(0.2)
+        assert network.deliver("b") == []          # 0.2 < 0.3
+        clock.advance(0.2)
+        assert network.deliver("b") == [("a", b"frame")]
 
 
 # ---------------------------------------------------------------------- #

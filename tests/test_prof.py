@@ -239,7 +239,8 @@ def test_global_registration_covers_the_tpu_programs():
     """Importing the tpu layer registers every named program — the
     observatory is the one place recompiles can be attributed, so the
     roster is pinned here."""
-    import automerge_tpu.tpu.paging  # noqa: F401 - registration side effect
+    import automerge_tpu.tpu.fingerprint  # noqa: F401 - registration side effect
+    import automerge_tpu.tpu.paging  # noqa: F401
     import automerge_tpu.tpu.sync_batch  # noqa: F401
 
     names = set(get_observatory().programs())
@@ -249,6 +250,7 @@ def test_global_registration_covers_the_tpu_programs():
         "paging.visible_ranked", "paging.patch_column_rows",
         "paging.dense_view", "paging.adopt_rows",
         "sync.build_filters", "sync.query_filters",
+        "sync.fingerprint_ranges",
     } <= names
 
 

@@ -189,18 +189,88 @@ class TestSyncStateCodec:
             assert decoded["sentHashes"] == {}
             assert "session" not in decoded
 
+    WD_AT_REST = {"wdRounds": 0, "wdStage": 0, "wdStalls": 0,
+                  "wdEscalations": 0, "wdResets": 0}
+
     def test_session_extension_round_trips(self):
         state = Sync.init_sync_state()
         session = {"epoch": 0xDEADBEEF, "seqOut": 12, "lastSeen": 9,
                    "peerEpoch": 77}
         blob = Sync.encode_sync_state(state, session=session)
         decoded = Sync.decode_sync_state(blob)
-        assert decoded["session"] == session
+        assert decoded["session"] == {**session, **self.WD_AT_REST}
         session_none_peer = dict(session, peerEpoch=None)
         decoded2 = Sync.decode_sync_state(
             Sync.encode_sync_state(state, session=session_none_peer)
         )
-        assert decoded2["session"] == session_none_peer
+        assert decoded2["session"] == {**session_none_peer, **self.WD_AT_REST}
+
+    def test_watchdog_counters_round_trip(self):
+        """ISSUE 18 satellite (bugfix): the watchdog/backoff ladder rides
+        the session extension, so a restart no longer re-arms a stalled
+        channel's escalation state from zero."""
+        state = Sync.init_sync_state()
+        session = {"epoch": 5, "seqOut": 2, "lastSeen": 1, "peerEpoch": 9,
+                   "wdRounds": 3, "wdStage": 1, "wdStalls": 4,
+                   "wdEscalations": 5, "wdResets": 2}
+        decoded = Sync.decode_sync_state(
+            Sync.encode_sync_state(state, session=session)
+        )
+        assert decoded["session"] == session
+
+    def test_pre_watchdog_blobs_decode_with_ladder_at_rest(self):
+        """Backward direction: a blob written before the watchdog tail
+        existed (extension stops after peerEpoch) still decodes — the
+        counters come back zero, not as a decode error."""
+        from automerge_tpu.codecs import Encoder
+        from automerge_tpu.sync import (
+            PEER_STATE_TYPE,
+            SESSION_EXT_VERSION,
+            _encode_hashes,
+        )
+
+        enc = Encoder()
+        enc.append_byte(PEER_STATE_TYPE)
+        _encode_hashes(enc, [])
+        enc.append_byte(SESSION_EXT_VERSION)
+        enc.append_uint32(5)
+        enc.append_uint53(2)
+        enc.append_uint53(1)
+        enc.append_byte(1)
+        enc.append_uint32(9)
+        decoded = Sync.decode_sync_state(enc.buffer)
+        assert decoded["session"] == {
+            "epoch": 5, "seqOut": 2, "lastSeen": 1, "peerEpoch": 9,
+            **self.WD_AT_REST,
+        }
+
+    def test_watchdog_tail_is_prefix_compatible(self):
+        """Forward direction: the new blob's prefix up to the old format's
+        length is byte-identical, so pre-watchdog decoders (which stop
+        after peerEpoch and tolerate trailing bytes) read it unchanged."""
+        from automerge_tpu.codecs import Encoder
+        from automerge_tpu.sync import (
+            PEER_STATE_TYPE,
+            SESSION_EXT_VERSION,
+            _encode_hashes,
+        )
+
+        state = Sync.init_sync_state()
+        session = {"epoch": 5, "seqOut": 2, "lastSeen": 1, "peerEpoch": 9,
+                   "wdRounds": 3, "wdStage": 1, "wdStalls": 4,
+                   "wdEscalations": 5, "wdResets": 2}
+        new_blob = Sync.encode_sync_state(state, session=session)
+        enc = Encoder()
+        enc.append_byte(PEER_STATE_TYPE)
+        _encode_hashes(enc, [])
+        enc.append_byte(SESSION_EXT_VERSION)
+        enc.append_uint32(5)
+        enc.append_uint53(2)
+        enc.append_uint53(1)
+        enc.append_byte(1)
+        enc.append_uint32(9)
+        old_blob = enc.buffer
+        assert new_blob[: len(old_blob)] == old_blob
 
     def test_pre_extension_blobs_still_decode(self):
         """Wire compatibility: blobs from the pre-session encoder (type

@@ -18,6 +18,8 @@ from automerge_tpu.errors import (
 )
 from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
 from automerge_tpu.sync_session import (
+    FLAG_PAYLOAD,
+    FLAG_V2,
     BackendDriver,
     SessionConfig,
     SyncSession,
@@ -73,14 +75,23 @@ class TestFrameCodec:
     def test_round_trip_payload(self):
         frame = encode_frame(7, 3, 2, b"payload-bytes")
         assert decode_frame(frame) == {
-            "epoch": 7, "seq": 3, "ack": 2, "payload": b"payload-bytes",
+            "epoch": 7, "seq": 3, "ack": 2, "flags": FLAG_PAYLOAD,
+            "payload": b"payload-bytes",
         }
 
     def test_round_trip_ack_only(self):
         frame = encode_frame(9, 0, 5, None)
         assert decode_frame(frame) == {
-            "epoch": 9, "seq": 0, "ack": 5, "payload": None,
+            "epoch": 9, "seq": 0, "ack": 5, "flags": 0, "payload": None,
         }
+
+    def test_v2_flag_rides_the_flags_byte(self):
+        frame = encode_frame(7, 3, 2, b"payload-bytes", FLAG_V2)
+        decoded = decode_frame(frame)
+        assert decoded["flags"] == FLAG_PAYLOAD | FLAG_V2
+        assert decoded["payload"] == b"payload-bytes"
+        ack = decode_frame(encode_frame(9, 0, 5, None, FLAG_V2))
+        assert ack["flags"] == FLAG_V2 and ack["payload"] is None
 
     @pytest.mark.parametrize("bit", [8, 40, 64, 200])
     def test_corrupt_frame_rejected_by_checksum(self, bit):

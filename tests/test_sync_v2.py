@@ -1,0 +1,672 @@
+"""Sync v2 suite (range-based set reconciliation, automerge_tpu/sync_v2.py).
+
+Covers the four layers of the v2 stack:
+
+- wire codec strictness: truncated, garbage, overlapping-range and
+  duplicate-item frames all reject with ``SyncProtocolError`` and the
+  receiving backend / sync state / hash index provably untouched;
+- host/device fingerprint bit-identity: ``HashIndex`` (prefix-XOR on host)
+  and ``tpu.fingerprint.FingerprintIndex`` (batched XOR reduction on
+  device) must agree bit for bit on every range;
+- deterministic convergence: divergent histories reconcile in at most
+  2*log2(n) round trips with no probabilistic failure mode;
+- the farm path: EVERY live v2 channel's fingerprint queries resolve as
+  ONE observatory-pinned device dispatch per sweep;
+- session negotiation: v1<->v2 pairings run byte-for-byte v1, v2<->v2
+  activates bilaterally, and a mid-session v2 error falls back to v1
+  without stalling the channel.
+"""
+import copy
+import hashlib
+import math
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import backend as Backend
+from automerge_tpu import sync as Sync
+from automerge_tpu.codecs import Encoder, hex_to_bytes
+from automerge_tpu.errors import EncodeError, SyncProtocolError
+from automerge_tpu.obs.metrics import enabled_metrics, get_metrics
+from automerge_tpu.obs.prof import enabled_observatory, get_observatory
+from automerge_tpu.sync import _encode_hashes
+from automerge_tpu.sync_session import (
+    FLAG_V2,
+    BackendDriver,
+    SessionConfig,
+    SyncSession,
+    decode_frame,
+)
+from automerge_tpu.sync_v2 import (
+    ITEM_THRESHOLD,
+    MAX_HASH,
+    MESSAGE_TYPE_SYNC_V2,
+    MIN_HASH,
+    RANGE_FINGERPRINT,
+    RANGE_ITEMS,
+    HashIndex,
+    decode_sync_message_v2,
+    encode_sync_message_v2,
+    generate_sync_message_v2,
+    index_for_backend,
+    receive_sync_message_v2,
+)
+from automerge_tpu.testing.chaos import ManualClock
+from automerge_tpu.tpu.farm import TpuDocFarm
+from automerge_tpu.tpu.fingerprint import FingerprintIndex
+from automerge_tpu.tpu.sync_farm import SyncFarm
+from automerge_tpu.columnar import encode_change
+
+
+def fake_hash(i) -> str:
+    """Deterministic 256-bit hex hash."""
+    return hashlib.sha256(str(i).encode()).hexdigest()
+
+
+def grow_backend(backend, actor, keys, start_seq=1):
+    for i, key in enumerate(keys):
+        buf = am.encode_change({
+            "actor": actor, "seq": start_seq + i, "startOp": start_seq + i,
+            "time": 0, "deps": Backend.get_heads(backend),
+            "ops": [{"action": "set", "obj": "_root", "key": key,
+                     "datatype": "uint", "value": i, "pred": []}],
+        })
+        backend, _ = Backend.apply_changes(backend, [buf])
+    return backend
+
+
+def make_backend(actor, n):
+    return grow_backend(Backend.init(), actor, [f"k{i}" for i in range(n)])
+
+
+def converge_v2(ba, bb, max_round_trips=64):
+    """Drives the raw v2 entry points until both sides go quiet; returns
+    (ba, bb, round_trips)."""
+    sa, sb = Sync.init_sync_state(), Sync.init_sync_state()
+    ia, ib = index_for_backend(ba), index_for_backend(bb)
+    trips = 0
+    for _ in range(max_round_trips):
+        sa, ma = generate_sync_message_v2(ba, sa, ia)
+        sb, mb = generate_sync_message_v2(bb, sb, ib)
+        if ma is None and mb is None:
+            break
+        trips += 1
+        if ma is not None:
+            bb, sb, _ = receive_sync_message_v2(bb, sb, ib, ma)
+        if mb is not None:
+            ba, sa, _ = receive_sync_message_v2(ba, sa, ia, mb)
+    return ba, bb, trips
+
+
+def raw_message(heads=(), need=(), ranges=(), changes=()):
+    """Hand-encodes a v2 frame WITHOUT the encoder's validation, so tests
+    can craft frames the strict encoder refuses to produce."""
+    enc = Encoder()
+    enc.append_byte(MESSAGE_TYPE_SYNC_V2)
+    _encode_hashes(enc, sorted(heads))
+    _encode_hashes(enc, sorted(need))
+    enc.append_uint32(len(ranges))
+    for r in ranges:
+        enc.append_raw_bytes(hex_to_bytes(r["lo"]))
+        enc.append_raw_bytes(hex_to_bytes(r["hi"]))
+        enc.append_byte(r["mode"])
+        if r["mode"] == RANGE_FINGERPRINT:
+            enc.append_uint53(r["count"])
+            enc.append_raw_bytes(hex_to_bytes(r["fp"]))
+        else:
+            enc.append_uint32(len(r["items"]))
+            for h in r["items"]:
+                enc.append_raw_bytes(hex_to_bytes(h))
+    enc.append_uint32(len(changes))
+    for change in changes:
+        enc.append_prefixed_bytes(change)
+    return enc.buffer
+
+
+def fp_range(lo, hi, count=1, fp=None):
+    return {"lo": lo, "hi": hi, "mode": RANGE_FINGERPRINT,
+            "count": count, "fp": fp or fake_hash("fp")}
+
+
+# ---------------------------------------------------------------------- #
+# HashIndex (host fingerprints)
+
+
+class TestHashIndex:
+    def test_fingerprints_match_brute_force(self):
+        hashes = sorted(fake_hash(i) for i in range(200))
+        index = HashIndex(hashes)
+        queries = [
+            (MIN_HASH, MAX_HASH),
+            (hashes[10], hashes[50]),          # half-open: excludes hi
+            (hashes[0], hashes[1]),
+            (hashes[7], hashes[7]),            # empty span
+            ("2" + "0" * 63, "7" + "f" * 63),  # bounds between members
+        ]
+        got = index.fingerprint_many(queries)
+        for (lo, hi), (count, fp) in zip(queries, got):
+            members = [h for h in hashes if lo <= h < hi]
+            acc = 0
+            for h in members:
+                acc ^= int(h, 16)
+            assert count == len(members)
+            assert fp == format(acc, "064x")
+
+    def test_incremental_insert_refreshes_fingerprints(self):
+        index = HashIndex()
+        assert index.fingerprint_many([(MIN_HASH, MAX_HASH)]) == [(0, "0" * 64)]
+        h = fake_hash(1)
+        assert index.insert(h) is True
+        assert index.insert(h) is False  # idempotent
+        assert index.contains(h)
+        assert index.fingerprint_many([(MIN_HASH, MAX_HASH)]) == [(1, h)]
+
+    def test_rejects_malformed_hashes(self):
+        index = HashIndex()
+        with pytest.raises(SyncProtocolError):
+            index.insert("abc")
+        with pytest.raises(SyncProtocolError):
+            index.insert("z" * 64)
+
+    def test_index_for_backend_refresh_is_idempotent(self):
+        backend = make_backend("aaaaaaaa", 5)
+        index = index_for_backend(backend)
+        assert len(index) == 5
+        again = index_for_backend(backend, index)
+        assert again is index and len(again) == 5
+
+
+# ---------------------------------------------------------------------- #
+# wire codec
+
+
+class TestCodecRoundTrip:
+    def test_full_round_trip(self):
+        items_range = sorted(fake_hash(i) for i in range(3))
+        message = {
+            "heads": sorted([fake_hash("h1"), fake_hash("h2")]),
+            "need": [fake_hash("n1")],
+            "ranges": [
+                {"lo": MIN_HASH, "hi": items_range[-1], "mode": RANGE_ITEMS,
+                 "items": items_range[:-1]},
+                fp_range(items_range[-1], MAX_HASH, count=7),
+            ],
+            "changes": [b"change-one", b"change-two"],
+        }
+        assert decode_sync_message_v2(encode_sync_message_v2(message)) == message
+
+    def test_empty_message_round_trips(self):
+        message = {"heads": [], "need": [], "ranges": [], "changes": []}
+        assert decode_sync_message_v2(encode_sync_message_v2(message)) == message
+
+    def test_trailing_bytes_ignored_for_forward_compat(self):
+        message = {"heads": [], "need": [], "ranges": [], "changes": []}
+        data = encode_sync_message_v2(message) + b"\x00\x01future-fields"
+        assert decode_sync_message_v2(data) == message
+
+    def test_encoder_refuses_inverted_bounds(self):
+        with pytest.raises(EncodeError):
+            encode_sync_message_v2({
+                "heads": [], "need": [], "changes": [],
+                "ranges": [fp_range(MAX_HASH[:-1] + "e", MIN_HASH)],
+            })
+
+    def test_encoder_refuses_overlapping_ranges(self):
+        a, b, c = sorted(fake_hash(i) for i in range(3))
+        with pytest.raises(EncodeError):
+            encode_sync_message_v2({
+                "heads": [], "need": [], "changes": [],
+                "ranges": [fp_range(a, c), fp_range(b, MAX_HASH)],
+            })
+
+    def test_encoder_refuses_unsorted_items(self):
+        a, b = sorted(fake_hash(i) for i in range(2))
+        with pytest.raises(EncodeError):
+            encode_sync_message_v2({
+                "heads": [], "need": [], "changes": [],
+                "ranges": [{"lo": MIN_HASH, "hi": MAX_HASH,
+                            "mode": RANGE_ITEMS, "items": [b, a]}],
+            })
+
+    def test_encoder_refuses_unknown_mode(self):
+        with pytest.raises(EncodeError):
+            encode_sync_message_v2({
+                "heads": [], "need": [], "changes": [],
+                "ranges": [{"lo": MIN_HASH, "hi": MAX_HASH, "mode": 9}],
+            })
+
+
+class TestCodecRejection:
+    """Every malformed shape raises SyncProtocolError — never a raw decode
+    exception — and decoding constructs no partial state."""
+
+    def valid(self):
+        return raw_message(
+            heads=[fake_hash("h")],
+            ranges=[fp_range(MIN_HASH, MAX_HASH, count=3)],
+            changes=[b"some-change-bytes"],
+        )
+
+    def test_every_truncation_rejects(self):
+        data = self.valid()
+        for keep in range(len(data)):
+            with pytest.raises(SyncProtocolError):
+                decode_sync_message_v2(data[:keep])
+
+    def test_garbage_rejects(self):
+        with pytest.raises(SyncProtocolError):
+            decode_sync_message_v2(bytes([MESSAGE_TYPE_SYNC_V2]) + b"\xff" * 40)
+
+    def test_wrong_type_byte_rejects(self):
+        with pytest.raises(SyncProtocolError, match="message type"):
+            decode_sync_message_v2(b"\x42" + self.valid()[1:])
+
+    def test_inverted_bounds_reject(self):
+        data = raw_message(ranges=[
+            {"lo": MAX_HASH[:-1] + "e", "hi": MIN_HASH,
+             "mode": RANGE_FINGERPRINT, "count": 0, "fp": "0" * 64},
+        ])
+        with pytest.raises(SyncProtocolError, match="inverted"):
+            decode_sync_message_v2(data)
+
+    def test_overlapping_ranges_reject(self):
+        a, b, c = sorted(fake_hash(i) for i in range(3))
+        data = raw_message(ranges=[fp_range(a, c), fp_range(b, MAX_HASH)])
+        with pytest.raises(SyncProtocolError, match="overlapping"):
+            decode_sync_message_v2(data)
+
+    def test_duplicate_items_reject(self):
+        h = fake_hash(1)
+        data = raw_message(ranges=[
+            {"lo": MIN_HASH, "hi": MAX_HASH, "mode": RANGE_ITEMS,
+             "items": [h, h]},
+        ])
+        with pytest.raises(SyncProtocolError, match="ascending"):
+            decode_sync_message_v2(data)
+
+    def test_out_of_range_item_rejects(self):
+        a, b, c = sorted(fake_hash(i) for i in range(3))
+        data = raw_message(ranges=[
+            {"lo": b, "hi": MAX_HASH, "mode": RANGE_ITEMS, "items": [a]},
+        ])
+        with pytest.raises(SyncProtocolError, match="outside"):
+            decode_sync_message_v2(data)
+
+    def test_unknown_mode_rejects(self):
+        enc = Encoder()
+        enc.append_byte(MESSAGE_TYPE_SYNC_V2)
+        _encode_hashes(enc, [])
+        _encode_hashes(enc, [])
+        enc.append_uint32(1)
+        enc.append_raw_bytes(hex_to_bytes(MIN_HASH))
+        enc.append_raw_bytes(hex_to_bytes(MAX_HASH))
+        enc.append_byte(7)
+        with pytest.raises(SyncProtocolError, match="unknown range mode"):
+            decode_sync_message_v2(enc.buffer)
+
+
+class TestReceiveLeavesStateUntouched:
+    """The acceptance property for satellite 3: a rejected frame leaves the
+    backend, the sync-state object AND the hash index provably unmodified —
+    the channel can keep operating on the same objects."""
+
+    def poisoned_frames(self):
+        a, b, c = sorted(fake_hash(i) for i in range(3))
+        h = fake_hash(9)
+        return [
+            raw_message(ranges=[fp_range(MIN_HASH, MAX_HASH)])[:-3],  # truncated
+            bytes([MESSAGE_TYPE_SYNC_V2]) + b"\xff" * 17,             # garbage
+            raw_message(ranges=[fp_range(a, c), fp_range(b, MAX_HASH)]),
+            raw_message(ranges=[{"lo": MIN_HASH, "hi": MAX_HASH,
+                                 "mode": RANGE_ITEMS, "items": [h, h]}]),
+            # valid envelope, inapplicable change bytes
+            raw_message(changes=[b"\x00garbage-not-a-change"]),
+        ]
+
+    def test_rejection_mutates_nothing(self):
+        backend = make_backend("aaaaaaaa", 4)
+        index = index_for_backend(backend)
+        state = Sync.init_sync_state()
+        heads_before = Backend.get_heads(backend)
+        state_snapshot = copy.deepcopy(state)
+        index_len = len(index)
+        for frame in self.poisoned_frames():
+            with pytest.raises(SyncProtocolError):
+                receive_sync_message_v2(backend, state, index, frame)
+            assert state == state_snapshot
+            assert Backend.get_heads(backend) == heads_before
+            assert len(index) == index_len
+        # ...and the same objects still sync normally afterwards
+        ba, bb, _ = converge_v2(backend, make_backend("bbbbbbbb", 2))
+        assert Backend.get_heads(ba) == Backend.get_heads(bb)
+
+    def test_rejections_are_counted(self):
+        backend = make_backend("aaaaaaaa", 1)
+        index = index_for_backend(backend)
+        state = Sync.init_sync_state()
+        metrics = get_metrics()
+        metrics.reset()
+        with enabled_metrics():
+            with pytest.raises(SyncProtocolError):
+                receive_sync_message_v2(
+                    backend, state, index,
+                    bytes([MESSAGE_TYPE_SYNC_V2]) + b"\xff" * 9,
+                )
+        assert metrics.as_dict()["sync.v2.messages.rejected"]["value"] == 1
+
+    def test_none_arguments_reject(self):
+        backend = make_backend("aaaaaaaa", 1)
+        index = index_for_backend(backend)
+        with pytest.raises(SyncProtocolError):
+            generate_sync_message_v2(None, Sync.init_sync_state(), index)
+        with pytest.raises(SyncProtocolError):
+            generate_sync_message_v2(backend, None, index)
+        with pytest.raises(SyncProtocolError):
+            receive_sync_message_v2(backend, None, index, b"\x45")
+        with pytest.raises(SyncProtocolError):
+            receive_sync_message_v2(None, Sync.init_sync_state(), index, b"\x45")
+
+
+# ---------------------------------------------------------------------- #
+# host/device fingerprint parity
+
+
+class TestHostDeviceParity:
+    def test_fingerprints_bit_identical(self):
+        rng = random.Random(5)
+        hashes = sorted(fake_hash(i) for i in range(150))
+        host = HashIndex(hashes)
+        device = FingerprintIndex()
+        device.sync_doc(0, hashes)
+        spans = [(MIN_HASH, MAX_HASH), (hashes[0], hashes[1]),
+                 (hashes[3], hashes[3])]
+        for _ in range(25):
+            i, j = sorted(rng.sample(range(len(hashes)), 2))
+            spans.append((hashes[i], hashes[j]))
+        got_host = host.fingerprint_many(spans)
+        got_device = device.fingerprint_ranges(
+            [(0, lo, hi) for lo, hi in spans]
+        )
+        assert got_host == got_device
+
+    def test_multi_doc_batch_keeps_documents_apart(self):
+        device = FingerprintIndex()
+        a = sorted(fake_hash(f"a{i}") for i in range(40))
+        b = sorted(fake_hash(f"b{i}") for i in range(9))
+        device.sync_doc(0, a)
+        device.sync_doc(1, b)
+        got = device.fingerprint_ranges([
+            (0, MIN_HASH, MAX_HASH), (1, MIN_HASH, MAX_HASH),
+            (1, b[2], b[5]), (0, a[0], a[0]),
+        ])
+        assert got[0] == HashIndex(a).fingerprint_many([(MIN_HASH, MAX_HASH)])[0]
+        assert got[1] == HashIndex(b).fingerprint_many([(MIN_HASH, MAX_HASH)])[0]
+        assert got[2] == HashIndex(b).fingerprint_many([(b[2], b[5])])[0]
+        assert got[3] == (0, "0" * 64)
+
+    def test_empty_query_list_dispatches_nothing(self):
+        assert FingerprintIndex().fingerprint_ranges([]) == []
+
+
+# ---------------------------------------------------------------------- #
+# convergence
+
+
+class TestConvergence:
+    @pytest.mark.parametrize("na,nb", [(0, 12), (12, 0), (60, 45), (1, 1)])
+    def test_divergent_histories_converge(self, na, nb):
+        ba = make_backend("aaaaaaaa", na)
+        bb = make_backend("bbbbbbbb", nb)
+        ba, bb, trips = converge_v2(ba, bb)
+        assert Backend.get_heads(ba) == Backend.get_heads(bb)
+        total = max(na + nb, 2)
+        assert trips <= 2 * math.log2(total) + 2
+
+    def test_round_trip_bound_holds_at_scale(self):
+        """The acceptance shape at test scale: two peers sharing a prefix
+        then diverging must reconcile within 2*log2(n) round trips."""
+        shared = [f"s{i}" for i in range(64)]
+        ba = make_backend("aaaaaaaa", 0)
+        ba = grow_backend(ba, "cccccccc", shared)
+        bb = grow_backend(Backend.init(), "cccccccc", shared)
+        ba = grow_backend(ba, "aaaaaaaa", [f"a{i}" for i in range(130)])
+        bb = grow_backend(bb, "bbbbbbbb", [f"b{i}" for i in range(170)])
+        ba, bb, trips = converge_v2(ba, bb)
+        assert Backend.get_heads(ba) == Backend.get_heads(bb)
+        assert trips <= 2 * math.log2(64 + 130 + 170)
+
+    def test_converged_channel_is_silent(self):
+        ba = make_backend("aaaaaaaa", 8)
+        bb = make_backend("bbbbbbbb", 8)
+        ba, bb, _ = converge_v2(ba, bb)
+        sa = Sync.init_sync_state()
+        sa, first = generate_sync_message_v2(ba, sa, index_for_backend(ba))
+        assert first is not None  # fresh state: one advert/probe
+        bb2, sb, _ = receive_sync_message_v2(
+            bb, Sync.init_sync_state(), index_for_backend(bb), first
+        )
+        # after the echo round the heads agree and both sides go quiet
+        _, _, trips = converge_v2(ba, bb)
+        assert trips == 0 or trips <= 3
+
+
+# ---------------------------------------------------------------------- #
+# farm: one batched fingerprint dispatch per sweep
+
+
+def farm_edit(farm, d, actor, seq, start_op, keys):
+    buf = encode_change({
+        "actor": actor, "seq": seq, "startOp": start_op, "time": 0,
+        "deps": sorted(farm.get_heads(d)),
+        "ops": [{"action": "set", "obj": "_root", "key": k,
+                 "datatype": "uint", "value": v, "pred": []}
+                for v, k in enumerate(keys)],
+    })
+    per_doc = [[] for _ in range(farm.num_docs)]
+    per_doc[d] = [buf]
+    farm.apply_changes(per_doc)
+
+
+class TestFarmBatchedFingerprints:
+    NUM_DOCS = 4
+
+    def make_pair(self):
+        fa = TpuDocFarm(self.NUM_DOCS, capacity=256)
+        fb = TpuDocFarm(self.NUM_DOCS, capacity=256)
+        for d in range(self.NUM_DOCS):
+            farm_edit(fa, d, "aaaaaaaa", 1, 1, [f"a{d}", f"x{d}"])
+            farm_edit(fb, d, "bbbbbbbb", 1, 1, [f"b{d}"])
+        return SyncFarm(fa), SyncFarm(fb)
+
+    def test_converges_with_one_dispatch_per_sweep(self):
+        """The tentpole farm property: a sweep over N live v2 channels
+        resolves ALL fingerprint queries — inbound checks, median splits,
+        fresh probes — as ONE compiled-program dispatch, pinned via the
+        amprof observatory."""
+        sa, sb = self.make_pair()
+        n = self.NUM_DOCS
+        a_states = [SyncFarm.init_state() for _ in range(n)]
+        b_states = [SyncFarm.init_state() for _ in range(n)]
+        protocols = ["v2"] * n
+        obs = get_observatory()
+        prog = obs.programs()["sync.fingerprint_ranges"]
+        with enabled_observatory():
+            prog.reset()
+            for _ in range(12):
+                out = sa.generate_messages(
+                    list(zip(range(n), a_states)), protocols=protocols
+                )
+                a_states = [s for s, _ in out]
+                sends = [(d, b_states[d], m)
+                         for d, (_, m) in enumerate(out) if m is not None]
+                if sends:
+                    recv = sb.receive_messages(sends, protocols=protocols)
+                    for (d, _, _), (state, _p) in zip(sends, recv):
+                        b_states[d] = state
+                out = sb.generate_messages(
+                    list(zip(range(n), b_states)), protocols=protocols
+                )
+                b_states = [s for s, _ in out]
+                sends = [(d, a_states[d], m)
+                         for d, (_, m) in enumerate(out) if m is not None]
+                if sends:
+                    recv = sa.receive_messages(sends, protocols=protocols)
+                    for (d, _, _), (state, _p) in zip(sends, recv):
+                        a_states[d] = state
+                if not sends:
+                    break
+            sweeps = prog.dispatches
+        for d in range(self.NUM_DOCS):
+            assert sa.farm.get_heads(d) == sb.farm.get_heads(d), f"doc {d}"
+        # at most one fingerprint dispatch per generate_messages sweep —
+        # NOT one per channel (4 docs would mean 4x the dispatches)
+        assert 0 < sweeps <= 2 * 12
+
+    def test_single_sweep_with_all_channels_probing_is_one_dispatch(self):
+        sa, _sb = self.make_pair()
+        n = self.NUM_DOCS
+        states = [SyncFarm.init_state() for _ in range(n)]
+        obs = get_observatory()
+        prog = obs.programs()["sync.fingerprint_ranges"]
+        with enabled_observatory():
+            prog.reset()
+            out = sa.generate_messages(
+                list(zip(range(n), states)), protocols=["v2"] * n
+            )
+            assert prog.dispatches == 1
+        assert all(m is not None for _, m in out)
+
+
+# ---------------------------------------------------------------------- #
+# session negotiation / interop / fallback
+
+
+def session_pair(v2a, v2b, *, driver_a=None, driver_b=None, seed=3):
+    clock = ManualClock()
+    da = driver_a or BackendDriver(make_backend("aaaaaaaa", 6))
+    db = driver_b or BackendDriver(make_backend("bbbbbbbb", 4))
+    sa = SyncSession(da, clock=clock, rng=random.Random(seed),
+                     config=SessionConfig(enable_v2=v2a))
+    sb = SyncSession(db, clock=clock, rng=random.Random(seed + 1),
+                     config=SessionConfig(enable_v2=v2b))
+    return clock, sa, sb
+
+
+def drive_transcript(clock, sa, sb, rounds=60):
+    """Lossless shuttle that records every frame's (sender, flags,
+    payload)."""
+    frames = []
+    for _ in range(rounds):
+        fa, fb = sa.poll(), sb.poll()
+        for sender, frame, receiver in (("a", fa, sb), ("b", fb, sa)):
+            if frame is None:
+                continue
+            decoded = decode_frame(frame)
+            frames.append((sender, decoded["flags"], decoded["payload"]))
+            receiver.handle(frame)
+        if fa is None and fb is None:
+            if sa.driver.heads() == sb.driver.heads():
+                return frames, True
+        clock.advance(0.05 if (fa or fb) else 0.26)
+    return frames, sa.driver.heads() == sb.driver.heads()
+
+
+class TestSessionNegotiation:
+    def test_v1_v2_pairing_is_byte_for_byte_v1(self):
+        """A v2-capable peer facing a v1 peer produces EXACTLY today's v1
+        transcript: same payload bytes in the same order — the capability
+        flag rides the session flags byte, invisible to the inner
+        protocol."""
+        ref_frames, ok = drive_transcript(*session_pair(False, False))
+        mixed_frames, ok2 = drive_transcript(*session_pair(True, False))
+        assert ok and ok2
+        assert [p for _, _, p in ref_frames] == [p for _, _, p in mixed_frames]
+        # the only difference: a's frames advertise the capability
+        for (_, ref_flags, _), (sender, flags, _) in zip(ref_frames,
+                                                         mixed_frames):
+            if sender == "a":
+                assert flags == ref_flags | FLAG_V2
+            else:
+                assert flags == ref_flags
+
+    def test_v2_pairing_activates_bilaterally_and_converges(self):
+        metrics = get_metrics()
+        metrics.reset()
+        with enabled_metrics():
+            clock, sa, sb = session_pair(True, True)
+            _, ok = drive_transcript(clock, sa, sb)
+        assert ok
+        assert sa.v2_active and sb.v2_active
+        assert sa.stats["v2_negotiated"] == 1
+        assert sb.stats["v2_negotiated"] == 1
+        snap = metrics.as_dict()
+        assert snap["sync.v2.sessions.negotiated"]["value"] == 2
+        assert snap["sync.v2.messages.generated"]["value"] > 0
+        assert snap.get("sync.v2.fallbacks", {"value": 0})["value"] == 0
+
+    def test_mixed_pairing_never_activates(self):
+        clock, sa, sb = session_pair(True, False)
+        _, ok = drive_transcript(clock, sa, sb)
+        assert ok
+        assert not sa.v2_active and not sb.v2_active
+        assert sa.stats["v2_negotiated"] == 0
+
+
+class FailingGenerateDriver(BackendDriver):
+    def generate_v2(self, state):
+        raise SyncProtocolError("injected v2 planner failure")
+
+
+class FailingReceiveDriver(BackendDriver):
+    def receive_v2(self, state, payload):
+        raise SyncProtocolError("injected v2 apply failure")
+
+
+class TestSessionFallback:
+    def test_generate_error_falls_back_same_call(self):
+        """A v2 generate error downgrades to v1 inside the SAME poll — the
+        channel never goes silent, and the peer symmetrically drops to v1
+        when the capability flag disappears."""
+        da = FailingGenerateDriver(make_backend("aaaaaaaa", 6))
+        clock, sa, sb = session_pair(True, True, driver_a=da)
+        _, ok = drive_transcript(clock, sa, sb)
+        assert ok
+        assert sa.stats["v2_fallbacks"] == 1
+        assert not sa.v2_active and not sb.v2_active
+        assert sa.stats["stalls"] == 0 and sb.stats["stalls"] == 0
+
+    def test_receive_error_acks_and_falls_back(self):
+        """A poisoned v2 frame is ACKed (not retransmitted into quarantine)
+        and the receiver latches v1; both sides still converge."""
+        db = FailingReceiveDriver(make_backend("bbbbbbbb", 4))
+        clock, sa, sb = session_pair(True, True, driver_b=db)
+        _, ok = drive_transcript(clock, sa, sb)
+        assert ok
+        assert sb.stats["v2_fallbacks"] == 1
+        assert not sa.quarantined and not sb.quarantined
+        assert not sa.v2_active and not sb.v2_active
+
+    def test_fallback_strips_v2_state(self):
+        da = FailingGenerateDriver(make_backend("aaaaaaaa", 6))
+        clock, sa, sb = session_pair(True, True, driver_a=da)
+        drive_transcript(clock, sa, sb)
+        assert not any(k.startswith("v2") for k in sa.state)
+
+    def test_peer_restart_renegotiates(self):
+        clock, sa, sb = session_pair(True, True)
+        _, ok = drive_transcript(clock, sa, sb)
+        assert ok and sa.v2_active
+        sb2 = SyncSession(sb.driver, clock=clock, rng=random.Random(9),
+                          config=SessionConfig(enable_v2=True))
+        # a sees the fresh epoch: peer beliefs reset, then the restart
+        # frame's own capability flag re-negotiates v2 immediately
+        frame = sb2.poll()
+        assert frame is not None
+        sa.handle(frame)
+        assert sa.stats["peer_restarts"] == 1
+        assert sa.peer_v2  # re-learned from the restart frame's flags
+        assert not any(k.startswith("v2") for k in sa.state)
+        _, ok = drive_transcript(clock, sa, sb2)
+        assert ok
+        assert sa.v2_active and sb2.v2_active
