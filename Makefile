@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers prof store sync2
+.PHONY: lint test native obs-report faults bench-smoke gate-bench chaos serve decode mesh mesh-workers mesh-shm prof store sync2
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m automerge_tpu.analysis automerge_tpu
@@ -72,13 +72,23 @@ mesh:
 	$(PY) bench.py --mesh --quick
 
 # process-worker mesh smoke (README "Process workers"): the same quick
-# gates with every shard in its own spawned worker process — pickled
-# column fan-out, migration over the pipe, clean worker shutdown. The
-# full MULTICHIP_r08 record run: `python bench.py --mesh --backend
-# process`; byte parity + crash recovery are tier-1
+# gates with every shard in its own spawned worker process, pinned to
+# the pickle-pipe ORACLE transport — pickled column fan-out, migration
+# over the pipe, clean worker shutdown. The full MULTICHIP_r08 record
+# run: `python bench.py --mesh --backend process --transport pickle`;
+# byte parity + crash recovery are tier-1
 # (tests/test_mesh_workers_smoke.py, tests/test_mesh_workers.py)
 mesh-workers:
-	$(PY) bench.py --mesh --quick --backend process
+	$(PY) bench.py --mesh --quick --backend process --transport pickle
+
+# shared-memory mesh smoke (README "Process workers"): the same quick
+# gates over the zero-copy column rings — bulk bytes ride the shm
+# segments and the pipe collapses to control frames, gated at
+# BENCH_MESH_SHM_PIPE_BYTES_PER_ROUND (default 4096 bytes/round/shard).
+# The full MULTICHIP_r09 record run (shm + pickle-oracle delta):
+# `python bench.py --mesh --backend process --transport shm`
+mesh-shm:
+	$(PY) bench.py --mesh --quick --backend process --transport shm
 
 # persistence-tier smoke (README "Persistence"): WAL-attached merge
 # round-trip, then both cold-start paths rebuilt from the on-disk log —

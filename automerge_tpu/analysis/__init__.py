@@ -74,7 +74,12 @@ Rule families (see core.RULES for the catalog):
   pipe-frame drift — ops sent with no handler, dead handlers, wrong
   request/response tuple arity, response fields read that nothing
   writes (AM503, modules ``workers``/``meshfarm`` plus files marked
-  ``# amlint: pipe-protocol``).
+  ``# amlint: pipe-protocol``); ``pickle.dumps``/``pickle.dump`` on the
+  shm transport's data plane (``parallel/shm.py`` plus files marked
+  ``# amlint: mesh-data-plane``) — bulk column payloads ride the
+  shared-memory rings struct-framed, so a pickled send path silently
+  refunds the zero-copy win; the pickle parity-oracle transport carries
+  the one justified suppression (AM504).
 - **AM6xx durability**: bare write-mode ``open()``/``os.write`` in
   durability-plane modules (``store/`` stems or files marked
   ``# amlint: durability-plane``) — durable bytes flow only through
@@ -101,9 +106,9 @@ from __future__ import annotations
 import tokenize
 from pathlib import Path
 
-from . import (boundary, catalog, durability, hotpath, meshrules, obsrules,
-               packing, profrules, protorules, shaperules, taxonomy, tracer,
-               workerrules)
+from . import (boundary, catalog, datarules, durability, hotpath, meshrules,
+               obsrules, packing, profrules, protorules, shaperules, taxonomy,
+               tracer, workerrules)
 from .core import RULES, FileContext, Finding, UsageError, collect_files
 from .graph import CallGraph
 
@@ -120,7 +125,7 @@ __all__ = [
 #: every rule family, in report order — each exposes check(ctxs, graph)
 FAMILIES = (packing, tracer, boundary, obsrules, catalog, taxonomy,
             hotpath, meshrules, workerrules, profrules, durability,
-            shaperules, protorules)
+            shaperules, protorules, datarules)
 
 
 def default_target() -> Path:

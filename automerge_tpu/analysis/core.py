@@ -112,6 +112,12 @@ RULES: dict[str, tuple[str, str]] = {
                           "4-tuples, requests (op, payload) 2-tuples), or "
                           "a response field is read that no worker-side "
                           "producer writes"),
+    "AM504": ("protocol", "pickle.dumps/pickle.dump in an shm data-plane "
+                          "module (parallel/shm.py or `# amlint: "
+                          "mesh-data-plane`) — bulk column payloads ride "
+                          "the shared-memory rings struct-framed, never "
+                          "pickle; the pickle parity-oracle transport is "
+                          "the one justified suppression"),
     "AM601": ("store", "bare write-mode open()/os.write in a durability-"
                        "plane module (store/ or `# amlint: durability-"
                        "plane`) — durable bytes go through "

@@ -185,19 +185,23 @@ def _p99_exemplar(snap: dict):
 
 def shard_table(metrics_snapshot: dict) -> dict:
     """Per-shard rollup of the mesh's shard-labelled instrument families
-    (``mesh.shard.<s>.*``, ``mesh.pipe.<s>.*`` and
+    (``mesh.shard.<s>.*``, ``mesh.pipe.<s>.*``, ``mesh.shm.<s>.*`` and
     ``serve.flush.shard.<s>.docs``) from a ``registry.as_dict()``
     snapshot: ``{shard: {suffix: value}}``, shards in ascending order.
     Histograms collapse to their count/sum/p99 (the figures the mesh
     bench reports per shard); counters and gauges pass their value
     through. The serving-side family keeps a ``flush.`` prefix so
     ``serve.flush.shard.<s>.docs`` never shadows the mesh's
-    ``mesh.shard.<s>.docs``, and the transport family keeps a ``pipe.``
-    prefix for the same reason (``mesh.pipe.<s>.bytes_out`` lands as
-    ``pipe.bytes_out``)."""
+    ``mesh.shard.<s>.docs``, and the transport families keep their
+    ``pipe.``/``shm.`` prefixes for the same reason
+    (``mesh.pipe.<s>.bytes_out`` lands as ``pipe.bytes_out``,
+    ``mesh.shm.<s>.bytes_out`` as ``shm.bytes_out`` — the two-transport
+    data plane's byte counters stay side by side per shard)."""
     import re
 
-    pattern = re.compile(r"^(mesh|serve\.flush)\.(shard|pipe)\.(\d+)\.(.+)$")
+    pattern = re.compile(
+        r"^(mesh|serve\.flush)\.(shard|pipe|shm)\.(\d+)\.(.+)$"
+    )
     table: dict[int, dict] = {}
     for name, snap in metrics_snapshot.items():
         m = pattern.match(name)
@@ -214,8 +218,8 @@ def shard_table(metrics_snapshot: dict) -> dict:
         suffix = m.group(4)
         if m.group(1) == "serve.flush":
             suffix = f"flush.{suffix}"
-        elif m.group(2) == "pipe":
-            suffix = f"pipe.{suffix}"
+        elif m.group(2) in ("pipe", "shm"):
+            suffix = f"{m.group(2)}.{suffix}"
         table.setdefault(int(m.group(3)), {})[suffix] = cell
     return {s: table[s] for s in sorted(table)}
 

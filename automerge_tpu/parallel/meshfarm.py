@@ -36,9 +36,17 @@ per-shard handle interface (``mesh_backend=`` ctor arg / the
   every shard's HOST work still serializes under one GIL;
 - ``"process"``: each shard's farm lives in its own worker process
   (``parallel/workers.py``, spawn-context, one JAX client per worker).
-  Deliveries fan out as pickled per-shard column batches, results come
-  back as compact outcome/patch frames (patches stay pickled until
-  someone indexes the result), and the controller additionally keeps
+  Deliveries fan out as per-shard column batches over a two-transport
+  data plane (``mesh_transport=`` / ``AM_MESH_TRANSPORT``): the default
+  ``"shm"`` transport writes each batch into a per-shard shared-memory
+  send ring and ships only a ``SlotRef`` control frame over the pipe,
+  with results struct-encoded into the worker's result ring the same
+  way (``parallel/shm.py``); ``"pickle"`` keeps the batch in the pipe
+  frame and stays the byte-for-byte parity oracle (and the automatic
+  fallback when POSIX shared memory is unavailable). Either way results
+  come back as compact outcome/patch frames (patches stay pickled until
+  someone indexes the result — under shm straight out of the mapped
+  segment), and the controller additionally keeps
   three tiny mirrors so untouched shards need zero round trips: a
   quarantine mirror (the serve batcher reads ``mesh.quarantine`` on
   every submit), a no-op-patch mirror (clock/heads/maxOp/pending per
@@ -62,6 +70,7 @@ by tests/test_mesh_parity.py). Under the process backend each worker
 simply has its own cache with identical behavior (same env knobs travel
 to the worker at spawn).
 """
+# amlint: mesh-data-plane
 from __future__ import annotations
 
 import contextlib
@@ -89,6 +98,7 @@ from ..tpu.farm import (
     exc_from_blob,
     outcome_from_wire,
 )
+from . import shm as _shm
 from .workers import WorkerHandle
 
 _METRICS = get_metrics()
@@ -139,6 +149,14 @@ _M_TELEMETRY_EVENTS = _METRICS.counter(
 _M_TELEMETRY_RECOVERED = _METRICS.counter(
     "mesh.telemetry.blackbox.recovered",
     "dead-worker black-box files recovered into crash dumps",
+)
+_M_SHM_SEGMENTS = _METRICS.gauge(
+    "mesh.shm.segments",
+    "live shared-memory ring segments owned by this controller",
+)
+_M_SHM_REMAPS = _METRICS.counter(
+    "mesh.shm.remaps",
+    "worker respawns that reclaimed + re-attached existing shm rings",
 )
 _FLIGHT = get_flight()
 _OBSERVATORY = get_observatory()
@@ -220,6 +238,26 @@ def _pipe_instruments(s: int) -> tuple:
                 f"mesh.pipe.{s}.deserialize_ms",
                 f"controller-side unpickle time per frame from shard {s}",
             ),
+            _METRICS.histogram(
+                f"mesh.pipe.{s}.payload_ms",
+                f"pickle/unpickle time per COLUMN-PAYLOAD frame on shard "
+                f"{s}'s pipe (inline batches + inline patch blobs)",
+            ),
+            _METRICS.histogram(
+                f"mesh.pipe.{s}.control_ms",
+                f"pickle/unpickle time per CONTROL frame on shard {s}'s "
+                f"pipe (ops, SlotRefs, acks, telemetry)",
+            ),
+            _METRICS.counter(
+                f"mesh.pipe.{s}.payload_bytes",
+                f"pipe bytes in COLUMN-PAYLOAD frames for shard {s}, both "
+                f"directions (zero when the shm rings carry the columns)",
+            ),
+            _METRICS.counter(
+                f"mesh.pipe.{s}.control_bytes",
+                f"pipe bytes in CONTROL frames for shard {s}, both "
+                f"directions (ops, SlotRefs, acks, telemetry deltas)",
+            ),
         )
         _PIPE_INSTRUMENTS[s] = m
     return m
@@ -227,12 +265,19 @@ def _pipe_instruments(s: int) -> tuple:
 
 def _pipe_recorder(s: int):
     """The ``on_pipe`` callback for shard ``s``'s WorkerHandle: cheap
-    no-op while metrics are disabled, full accounting otherwise."""
+    no-op while metrics are disabled, full accounting otherwise. The
+    ``kind`` leg splits column-payload frames from control frames so
+    ``serialize_ms``'s aggregate has an attributable breakdown — under
+    the shm transport the payload histograms go silent and the whole
+    pickle tax is visibly control-frame noise."""
 
-    def on_pipe(direction: str, nbytes: int, pickle_s: float) -> None:
+    def on_pipe(direction: str, nbytes: int, pickle_s: float,
+                kind: str = "payload") -> None:
         if not _METRICS.enabled:
             return
-        b_out, b_in, f_out, f_in, ser_ms, deser_ms = _pipe_instruments(s)
+        (b_out, b_in, f_out, f_in, ser_ms, deser_ms,
+         payload_ms, control_ms,
+         payload_bytes, control_bytes) = _pipe_instruments(s)
         if direction == "out":
             b_out.inc(nbytes)
             f_out.inc()
@@ -241,8 +286,47 @@ def _pipe_recorder(s: int):
             b_in.inc(nbytes)
             f_in.inc()
             deser_ms.observe(pickle_s * 1000.0)
+        if kind == "payload":
+            payload_ms.observe(pickle_s * 1000.0)
+            payload_bytes.inc(nbytes)
+        else:
+            control_ms.observe(pickle_s * 1000.0)
+            control_bytes.inc(nbytes)
 
     return on_pipe
+
+
+# the shm transport's accounting twin: bytes that moved through the
+# rings instead of the pipe, ring occupancy, and the stall/fallback
+# count the backpressure design trades deadlocks for
+_SHM_INSTRUMENTS: dict[int, tuple] = {}
+
+
+def _shm_instruments(s: int) -> tuple:
+    m = _SHM_INSTRUMENTS.get(s)
+    if m is None:
+        m = (
+            _METRICS.counter(
+                f"mesh.shm.{s}.bytes_out",
+                f"column-batch bytes written to shard {s}'s send ring",
+            ),
+            _METRICS.counter(
+                f"mesh.shm.{s}.bytes_in",
+                f"result-frame bytes read from shard {s}'s result ring",
+            ),
+            _METRICS.gauge(
+                f"mesh.shm.{s}.slots_in_use",
+                f"shard {s} result-ring slots held (worker-side writes + "
+                f"controller-side lazy patches)",
+            ),
+            _METRICS.counter(
+                f"mesh.shm.{s}.stalls",
+                f"shard {s} shm stalls: ring-full waits, oversize batches "
+                f"and responses degraded to the inline pickle path",
+            ),
+        )
+        _SHM_INSTRUMENTS[s] = m
+    return m
 
 
 def _route(num_docs: int, num_shards: int) -> np.ndarray:
@@ -368,6 +452,46 @@ class _LazyPatches:
         self._patches = state["patches"]
 
 
+class _ShmPatches(_LazyPatches):
+    """One shard's patch column still sitting in its result-ring slot:
+    the slot stays CONSUMER_HELD until someone indexes the result, then
+    the blob unpickles straight out of the mapped segment (no
+    controller-side copy) and the slot frees for the worker's next
+    response. Dropping the result without touching it frees the slot
+    too (``__del__``); a farm ``close()`` before that is also fine —
+    ``release`` is a no-op on a closed ring, the patches are just gone
+    with the segment."""
+
+    __slots__ = ("_ring", "_slot", "_off", "_len")
+
+    def __init__(self, ring, slot: int, off: int, length: int):
+        super().__init__(None)
+        self._ring = ring
+        self._slot = int(slot)
+        self._off = int(off)
+        self._len = int(length)
+
+    def get(self) -> list:
+        if self._patches is None:
+            view = self._ring.slot_view(self._slot)
+            blob = view[self._off:self._off + self._len]
+            try:
+                self._patches = pickle.loads(blob)
+            finally:
+                del blob, view
+            self._ring.release(self._slot)
+            self._ring = None
+        return self._patches
+
+    def __getstate__(self):  # materialize before leaving the process
+        return {"blob": None, "patches": self.get()}
+
+    def __del__(self):
+        ring = getattr(self, "_ring", None)
+        if ring is not None:
+            ring.release(self._slot)
+
+
 class _MeshApplyResult(FarmApplyResult):
     """``FarmApplyResult`` whose patches materialize lazily out of the
     per-shard pickled frames. Indexing (and iteration, which routes
@@ -413,6 +537,15 @@ class MeshFarm:
     (process backend) pre-compiles each worker's jit caches against a
     throwaway farm before the readiness barrier lifts.
 
+    `mesh_transport` picks the process backend's data plane: "shm"
+    (shared-memory column rings, pipe carries control frames only),
+    "pickle" (batches ride the pipe frames — the parity oracle), or
+    None/"auto" (env ``AM_MESH_TRANSPORT``, else shm when the host
+    supports it). Explicitly requesting "shm" on a host without POSIX
+    shared memory degrades to "pickle" rather than failing — the
+    transports are byte-for-byte interchangeable. Inline backends have
+    no transport; the resolved value is always "pickle" there.
+
     `store_dir` turns on the crash-consistent persistence tier
     (automerge_tpu/store): each shard owns ``<store_dir>/shard-NNN`` —
     workers (or inline shards) recover + hydrate from it on open, commit
@@ -429,6 +562,7 @@ class MeshFarm:
                  reconcile_interval: int | None = 64,
                  spare_slots: int | None = None,
                  mesh_backend: str | None = None,
+                 mesh_transport: str | None = None,
                  rebalance_policy="page_load",
                  rebalance_interval: int | None = None,
                  worker_timeout: float | None = None,
@@ -442,6 +576,21 @@ class MeshFarm:
                 f"mesh_backend must be 'inline' or 'process', "
                 f"got {mesh_backend!r}"
             )
+        if mesh_transport is None:
+            mesh_transport = os.environ.get("AM_MESH_TRANSPORT", "auto")
+        if mesh_transport not in ("auto", "pickle", "shm"):
+            # amlint: disable=AM401 — API-usage validation, not a
+            # data-plane fault (nothing was decoded or dispatched)
+            raise ValueError(
+                f"mesh_transport must be 'auto', 'pickle' or 'shm', "
+                f"got {mesh_transport!r}"
+            )
+        if mesh_backend != "process":
+            mesh_transport = "pickle"  # no pipe to take off the data path
+        elif mesh_transport != "pickle":
+            # auto resolves to shm; an explicit shm ask degrades to the
+            # pickle oracle when the host has no working POSIX shm
+            mesh_transport = "shm" if _shm.shm_available() else "pickle"
         if store_dir is not None and rebalance_interval:
             # amlint: disable=AM401 — API-usage validation, not a
             # data-plane fault (nothing was decoded or dispatched)
@@ -462,6 +611,7 @@ class MeshFarm:
         self.num_docs = num_docs
         self.num_shards = num_shards
         self.backend = mesh_backend
+        self.transport = mesh_transport
         self.reconcile_interval = reconcile_interval
         self.rebalance_policy = rebalance_policy
         self.rebalance_interval = rebalance_interval
@@ -492,6 +642,18 @@ class MeshFarm:
                 warm_buffers=tuple(warm_changes) if warm_changes else None,
                 store_dir=self._shard_store_dir(store_dir, s),
             ))
+        # shm transport: the controller owns one send ring + one result
+        # ring per shard; workers attach by name (spec["shm"]) at spawn
+        # and RE-attach to the same segments on respawn
+        self._rings: list[tuple] = []
+        if mesh_backend == "process" and mesh_transport == "shm":
+            for spec in specs:
+                s = spec["shard"]
+                send = _shm.create_ring(f"s{s}-tx")
+                result = _shm.create_ring(f"s{s}-rx")
+                self._rings.append((send, result))
+                spec["shm"] = {"send": send.name, "result": result.name}
+            _M_SHM_SEGMENTS.set(2 * num_shards)
         if mesh_backend == "process":
             # start every worker before awaiting any readiness message,
             # so farm construction + jit warmup overlap across workers
@@ -601,6 +763,14 @@ class MeshFarm:
             if path:
                 with contextlib.suppress(OSError):
                     os.remove(path)
+        if self._rings:
+            # workers are down; unlink every segment so /dev/shm is clean
+            # (pinned by tests/test_mesh_workers.py)
+            for rings in self._rings:
+                for ring in rings:
+                    ring.close()
+            self._rings = []
+            _M_SHM_SEGMENTS.set(0)
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
@@ -759,9 +929,12 @@ class MeshFarm:
         sent = []
         crashed = {}
         for s in touched:
+            batch = (
+                self._tx_columns(s, groups[s]) if self._rings else groups[s]
+            )
             try:
                 self._handles[s].request(
-                    "apply", (groups[s], is_local, want_phases, obs)
+                    "apply", (batch, is_local, want_phases, obs)
                 )
                 sent.append(s)
             except WorkerCrashError as exc:
@@ -797,14 +970,11 @@ class MeshFarm:
             )
         if errors:
             _raise_first_shard_error(errors)
-        frames = {
-            s: _LazyPatches(resp["patches"])
-            for s, resp in responses.items()
-        }
-        outcome_cols = {
-            s: [outcome_from_wire(w) for w in resp["outcomes"]]
-            for s, resp in responses.items()
-        }
+        frames = {}
+        outcome_cols = {}
+        for s, resp in responses.items():
+            frames[s], wires = self._rx_result(s, resp)
+            outcome_cols[s] = [outcome_from_wire(w) for w in wires]
         outcomes = [
             outcome_cols[shard_of[g]][local_of[g]]
             if shard_of[g] in outcome_cols
@@ -830,6 +1000,77 @@ class MeshFarm:
                 (tuple(per_doc_buffers[d]), is_local)
             )
         return _MeshApplyResult(patches, outcomes, lazy)
+
+    # -- the shm transport's two legs ---------------------------------- #
+
+    def _shm_stall(self, s: int, reason: str, nbytes: int) -> None:
+        """One shm degradation tick: ring-full wait, oversize batch, or a
+        worker response that fell back inline. Counted per shard and
+        flight-recorded so a transport that quietly stopped being
+        zero-copy shows up in the timeline."""
+        if _METRICS.enabled:
+            _shm_instruments(s)[3].inc()
+        if _FLIGHT.enabled:
+            # plain ints only: these fields land in flight JSONL dumps,
+            # where a stray np.int64 would stringify (the PR 14 bug class)
+            _FLIGHT.record(
+                "mesh.shm.stall", shard=int(s), reason=reason,
+                nbytes=int(nbytes),
+            )
+
+    def _tx_columns(self, s: int, batch: list):
+        """Stages one shard's column batch in its send ring and returns
+        the ``SlotRef`` control frame — or the batch itself when the
+        ring cannot take it (oversize payload, or full past the acquire
+        timeout), in which case this one delivery rides the pickle
+        oracle path. Degrade, never deadlock."""
+        send_ring, _ = self._rings[s]
+        nbytes = _shm.measure_columns(batch)
+        if nbytes > send_ring.slot_bytes:
+            self._shm_stall(s, "oversize", nbytes)
+            return batch
+        waits = send_ring.stalls
+        try:
+            slot, gen = send_ring.acquire(timeout=1.0)
+        except _shm.RingStall:
+            self._shm_stall(s, "ring_full", nbytes)
+            return batch
+        if send_ring.stalls != waits:
+            self._shm_stall(s, "waited", nbytes)
+        view = send_ring.slot_view(slot)
+        try:
+            used = _shm.encode_columns_into(view, batch)
+        finally:
+            del view
+        if _METRICS.enabled:
+            _shm_instruments(s)[0].inc(used)
+        return send_ring.publish(slot, gen, used)
+
+    def _rx_result(self, s: int, resp: dict):
+        """One apply response's bulk payload, as ``(patch frame,
+        outcome wires)``: read out of the result ring when the worker
+        shipped a ``SlotRef`` (the slot stays CONSUMER_HELD inside the
+        returned ``_ShmPatches`` until someone materializes patches —
+        that is the zero-copy hold), from the inline pickled fields
+        otherwise. An inline response while the shm transport is on IS
+        the worker's declared slot-exhaustion fallback — metered as a
+        stall so the degradation stays visible."""
+        ref = resp["patches"]
+        if not isinstance(ref, _shm.SlotRef):
+            if self._rings:
+                self._shm_stall(s, "inline_response", len(ref))
+            return _LazyPatches(ref), resp["outcomes"]
+        _, result_ring = self._rings[s]
+        view = result_ring.accept(ref)
+        try:
+            (p_off, p_len), wires = _shm.decode_result(view)
+        finally:
+            del view
+        if _METRICS.enabled:
+            m = _shm_instruments(s)
+            m[1].inc(ref.nbytes)
+            m[2].set(result_ring.slots_in_use())
+        return _ShmPatches(result_ring, ref.slot, p_off, p_len), wires
 
     def _noop_patch_mirror(self, g: int) -> dict:
         """The patch of a delivery that changed nothing, built from the
@@ -888,9 +1129,32 @@ class MeshFarm:
                 blackbox_events=recovered,
             )
             _FLIGHT.trigger("mesh.worker.crash", shard=s)
+        freed_slots = 0
+        if self._rings:
+            # reclaim the ring slots the dead worker may have held: the
+            # send ring entirely (this shard's delivery already failed —
+            # nothing of ours is outstanding in it), the result ring's
+            # PRODUCER_HELD slots only — CONSUMER_HELD ones back live
+            # ``_ShmPatches`` from earlier responses and stay valid
+            # across the respawn; the bumped generation counters keep
+            # any stale pre-crash SlotRef from aliasing a reused slot
+            send_ring, result_ring = self._rings[s]
+            freed_slots = send_ring.reclaim() + result_ring.reclaim(
+                held_by_producer_only=True
+            )
         new_pid = h.respawn()
         _M_W_SPAWNS.inc()
         _M_W_RESPAWNS.inc()
+        if self._rings:
+            # the respawned worker re-attached the same segments by name
+            _M_SHM_REMAPS.inc()
+            if _FLIGHT.enabled:
+                # plain ints only (JSONL dump fields — PR 14 bug class)
+                _FLIGHT.record(
+                    "mesh.shm.remap", shard=int(s),
+                    epoch=int(h.spec.get("epoch", 0)),
+                    freed_slots=int(freed_slots),
+                )
         owned = [g for g in self._owners[s] if g is not None]
         in_flight = set(in_flight)
         replay_items = [

@@ -13,21 +13,28 @@ backend now run in N processes.
 
 Protocol (length-framed pickles over a ``multiprocessing`` pipe):
 
-- parent -> child: ``(op, payload)`` — deliveries fan out as pickled
-  per-shard column batches (raw change bytes + local routing indices;
-  shards share NO mutable state, so nothing else needs to travel).
-  Apply payloads carry an ``obs`` leg: the controller's flight-enable
-  bit and the ambient ``DispatchSpan`` id, so worker-side latency
-  observations stamp the controller's trace ids (restored via
-  ``obs.scope.exemplar_context``);
+- parent -> child: ``(op, payload)`` — deliveries fan out as per-shard
+  column batches (raw change bytes + local routing indices; shards
+  share NO mutable state, so nothing else needs to travel). Under the
+  pickle transport the batch itself rides in the frame; under the shm
+  transport (``parallel/shm.py``) the batch is already sitting in the
+  shard's send ring and ``payload[0]`` is a tiny ``SlotRef`` control
+  handle instead — same tuple arity either way. Apply payloads carry
+  an ``obs`` leg: the controller's flight-enable bit and the ambient
+  ``DispatchSpan`` id, so worker-side latency observations stamp the
+  controller's trace ids (restored via ``obs.scope.exemplar_context``);
 - child -> parent: ``(status, payload, metrics_delta, flight_events)``
-  — apply results return as compact frames (double-pickled patch blob +
-  flat outcome tuples, see ``tpu.farm.result_to_wire``) so the
-  controller defers patch materialization until someone actually
-  indexes the result; every response piggybacks the worker registry's
-  metric delta (exemplars included), the worker flight recorder's
-  unshipped tail (heartbeat pongs ship it too), and, on request, the
-  worker's phase-profile dump for ``--watch`` attribution.
+  — apply results return as compact frames (patch blob + flat outcome
+  tuples, see ``tpu.farm.result_to_wire``) so the controller defers
+  patch materialization until someone actually indexes the result.
+  Under shm the worker struct-encodes the frame into its result ring
+  and ``resp["patches"]``/``resp["outcomes"]`` become one shared
+  ``SlotRef`` (falling back to the inline pickled form when the ring
+  is briefly full — degrade, never deadlock); every response
+  piggybacks the worker registry's metric delta (exemplars included),
+  the worker flight recorder's unshipped tail (heartbeat pongs ship it
+  too), and, on request, the worker's phase-profile dump for
+  ``--watch`` attribution.
 
 Crash forensics: when flight is enabled the worker maintains a bounded
 **black-box file** (``obs.flight.write_blackbox``: shard-tagged flight
@@ -51,6 +58,7 @@ policy is the controller's (meshfarm.py) — the handle only detects and
 reports via ``WorkerCrashError``.
 """
 # amlint: mesh-worker
+# amlint: mesh-data-plane
 from __future__ import annotations
 
 import multiprocessing as mp
@@ -60,6 +68,12 @@ import signal
 import time
 
 from ..errors import WorkerCrashError
+from . import shm as _shm
+
+#: how long a worker waits for a free result slot before degrading the
+#: one response to the inline pickle path (the controller meters it as a
+#: ``mesh.shm.<s>.stalls`` tick)
+_RESULT_SLOT_TIMEOUT_S = 0.25
 
 _PING_TIMEOUT_S = 5.0
 
@@ -99,6 +113,14 @@ def _worker_main(conn, spec: dict) -> None:
     if "XLA_FLAGS" in os.environ and "XLA_FLAGS" not in stripped:
         del os.environ["XLA_FLAGS"]
     os.environ.update(stripped)
+
+    # shm transport: map the controller-owned rings by name BEFORE the
+    # heavy imports (pure stdlib; a respawned worker re-attaches to the
+    # same segments here — that is the "remap" the controller meters)
+    send_ring = result_ring = None
+    if spec.get("shm"):
+        send_ring = _shm.attach_ring(spec["shm"]["send"])
+        result_ring = _shm.attach_ring(spec["shm"]["result"])
 
     # each worker records into ITS OWN process-wide registry and flight
     # recorder and ships deltas/event tails back with every response; the
@@ -204,11 +226,26 @@ def _worker_main(conn, spec: dict) -> None:
                 obs = payload[3] if len(payload) > 3 else None
                 flight.enabled = bool(obs and obs.get("flight"))
                 observatory.enabled = bool(obs and obs.get("prof"))
+                if send_ring is not None and isinstance(payload[0],
+                                                        _shm.SlotRef):
+                    # the column batch is in the send ring, not the frame:
+                    # validate the handle, copy the buffers out, free the
+                    # slot so the controller's next delivery can reuse it
+                    ref = payload[0]
+                    view = send_ring.accept(ref)
+                    try:
+                        active = _shm.decode_columns(view)
+                    finally:
+                        del view
+                        send_ring.release(ref.slot)
+                    payload = (active,) + tuple(payload[1:])
                 with exemplar_context(obs.get("exemplar") if obs else None):
                     resp = _do_apply(
                         farm, payload, PhaseProfile, use_profile,
                         result_to_wire, exc_to_blob,
                     )
+                if result_ring is not None:
+                    resp = _ship_result_shm(result_ring, resp)
                 if isinstance(resp, dict) and resp.get("phases"):
                     last_phases = resp["phases"]
             else:
@@ -230,6 +267,9 @@ def _worker_main(conn, spec: dict) -> None:
             conn.send(("err", exc_to_blob(exc), delta, flight.ship()))
     if store is not None:
         store.close()  # final durability barrier on clean shutdown
+    for ring in (send_ring, result_ring):
+        if ring is not None:
+            ring.close()  # attach side: drops the mapping, never unlinks
 
 
 def _do_apply(farm, payload, PhaseProfile, use_profile, result_to_wire,
@@ -266,6 +306,31 @@ def _do_apply(farm, payload, PhaseProfile, use_profile, result_to_wire,
     }
     resp["phases"] = phases
     resp["wall_s"] = wall_s
+    return resp
+
+
+def _ship_result_shm(result_ring, resp: dict) -> dict:
+    """Moves the bulk of one apply response — the patch blob and the
+    outcome tuples — into the result ring, leaving a ``SlotRef`` where
+    the payload was. A full ring (controller holding every slot as lazy
+    patches) or an oversize frame degrades THIS response to the inline
+    pickled form instead of ever blocking the op loop; the controller
+    notices the inline shape and meters the stall."""
+    frame = _shm.encode_result(resp["patches"], resp["outcomes"])
+    if len(frame) > result_ring.slot_bytes:
+        return resp
+    try:
+        slot, gen = result_ring.acquire(timeout=_RESULT_SLOT_TIMEOUT_S)
+    except _shm.RingStall:
+        return resp
+    view = result_ring.slot_view(slot)
+    try:
+        view[:len(frame)] = frame
+    finally:
+        del view
+    ref = result_ring.publish(slot, gen, len(frame))
+    resp["patches"] = ref
+    resp["outcomes"] = ref
     return resp
 
 
@@ -354,12 +419,17 @@ class WorkerHandle:
     ``on_delta`` receives each response's metric delta frame;
     ``on_flight`` receives each response's shipped flight-event tail;
     ``on_rpc`` fires once per request; ``on_pipe`` receives
-    ``(direction, frame_bytes, pickle_seconds)`` for every frame the
-    handle moves — the mesh pickle tax, measured (all injected by
-    meshfarm so this module never touches the controller's
-    process-global registries). With ``on_pipe`` set the handle pickles
-    frames explicitly (``Connection.send`` == ``send_bytes(dumps(...))``,
-    so the child's native protocol is unchanged).
+    ``(direction, frame_bytes, pickle_seconds, kind)`` for every frame
+    the handle moves — the mesh pickle tax, measured, with ``kind``
+    splitting column-payload frames (``"payload"``: an apply request
+    carrying the batch inline, a response carrying an inline patch
+    blob) from control frames (``"control"``: everything else — ops,
+    SlotRefs, acks) so the shm transport's win is attributable per
+    frame class (all injected by meshfarm so this module never touches
+    the controller's process-global registries). With ``on_pipe`` set
+    the handle pickles frames explicitly (``Connection.send`` ==
+    ``send_bytes(dumps(...))``, so the child's native protocol is
+    unchanged).
 
     ``last_ok`` is the monotonic timestamp of the last successful
     response (readiness counts) — ``heartbeat_age()`` is what the crash
@@ -516,7 +586,16 @@ class WorkerHandle:
         buf = self.conn.recv_bytes()
         t0 = time.perf_counter()
         msg = pickle.loads(buf)
-        self._on_pipe("in", len(buf), time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        # a response is a column payload iff the patch blob rides inline;
+        # under shm it is a SlotRef and the frame is pure control
+        payload_in = (
+            isinstance(msg, tuple) and len(msg) == 4
+            and isinstance(msg[1], dict)
+            and isinstance(msg[1].get("patches"), (bytes, bytearray))
+        )
+        self._on_pipe("in", len(buf), dt,
+                      "payload" if payload_in else "control")
         return msg
 
     def request(self, op: str, payload=None) -> None:
@@ -529,11 +608,22 @@ class WorkerHandle:
                 self.conn.send((op, payload))
             else:
                 t0 = time.perf_counter()
+                # amlint: disable=AM504 — the pickle-ORACLE transport: under
+                # mesh_transport="pickle" the column batch legitimately rides
+                # the frame (byte-for-byte parity baseline); under shm the
+                # batch is a SlotRef by the time it reaches here
                 buf = pickle.dumps((op, payload),
                                    protocol=pickle.HIGHEST_PROTOCOL)
                 ser_s = time.perf_counter() - t0
                 self.conn.send_bytes(buf)
-                self._on_pipe("out", len(buf), ser_s)
+                # an apply whose batch rides inline is the column payload
+                # path; a SlotRef apply (shm) is a control frame
+                payload_out = (
+                    op == "apply" and isinstance(payload, tuple)
+                    and bool(payload) and isinstance(payload[0], list)
+                )
+                self._on_pipe("out", len(buf), ser_s,
+                              "payload" if payload_out else "control")
         except (OSError, BrokenPipeError, ValueError) as e:
             raise self._crash(f"pipe closed mid-send ({e!r})") from e
 

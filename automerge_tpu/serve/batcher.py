@@ -32,8 +32,15 @@ Admission control happens at ``submit`` time, before anything is queued:
   backpressure releases after a flush.
 
 The batcher is farm-implementation-agnostic: fronting a process-worker
-``MeshFarm`` (PR 12, ``mesh_backend="process"``) changes nothing above.
-A worker crash mid-flush surfaces exactly like any mid-window poisoning:
+``MeshFarm`` (PR 12, ``mesh_backend="process"``) changes nothing above,
+under either of the mesh's transports (PR 19, ``mesh_transport=``):
+with the shared-memory data plane the flush's patch columns stay parked
+in each worker's mapped result ring until this layer's reply fan-out
+actually indexes them — the JSON-ified patch a session receives is
+unpickled straight out of the shared segment, with no controller-side
+copy in between, and a flush whose report only reads ``outcomes`` never
+touches the patch bytes at all. A worker crash mid-flush surfaces
+exactly like any mid-window poisoning:
 the dispatch quarantines the crashed shard's in-flight docs under
 ``WorkerCrashError``, the flush report's ``quarantined_docs`` diff picks
 them up, their entries are never acked, and clients retry after

@@ -1,0 +1,30 @@
+# amlint: mesh-data-plane — fixture: struct codecs on the send path,
+# receive-side unpickling stays free (AM504)
+import pickle
+
+from automerge_tpu.parallel import shm
+
+
+def stage_delivery(send_ring, batch):
+    """The blessed shape: the batch goes through the shm codec straight
+    into the mapped slot — counts + lengths + raw bytes, no serializer
+    on the path."""
+    nbytes = shm.measure_columns(batch)
+    slot, gen = send_ring.acquire()
+    view = send_ring.slot_view(slot)
+    used = shm.encode_columns_into(view, batch)
+    del view
+    assert used == nbytes
+    return send_ring.publish(slot, gen, used)
+
+
+def materialize_patches(result_ring, ref):
+    """Receive-side ``pickle.loads`` is the contract, not a leak: the
+    patch blob inside a result frame is opaque pickled bytes by design,
+    unpickled lazily straight out of the mapped segment."""
+    view = result_ring.accept(ref)
+    (off, length), _wires = shm.decode_result(view)
+    patches = pickle.loads(view[off:off + length])
+    del view
+    result_ring.release(ref.slot)
+    return patches

@@ -1,0 +1,15 @@
+# amlint: mesh-data-plane — fixture: the justified pickle-oracle path
+# silences AM504
+import pickle
+
+
+def send_oracle_frame(conn, op, payload):
+    """The one blessed pickle on the data plane: the parity-ORACLE
+    transport, where the whole batch rides the pipe frame as the
+    byte-for-byte baseline the shm transport is judged against (and the
+    fallback for hosts without POSIX shared memory)."""
+    # amlint: disable=AM504 — this IS the pickle parity-oracle transport:
+    # under mesh_transport="pickle" the batch legitimately rides the frame
+    buf = pickle.dumps((op, payload), protocol=pickle.HIGHEST_PROTOCOL)
+    conn.send_bytes(buf)
+    return len(buf)

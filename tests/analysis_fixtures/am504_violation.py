@@ -1,0 +1,19 @@
+# amlint: mesh-data-plane — fixture: pickled bulk payload on the shm
+# data plane (AM504)
+import pickle
+
+
+def stage_delivery(send_ring, batch):
+    """The forbidden shape: the column batch is flat bytes already, but
+    this path re-serializes it through pickle before it touches the ring
+    — the zero-copy transport silently pays the tax it was built to
+    remove while every dashboard still says "shm"."""
+    blob = pickle.dumps(batch)
+    slot, gen = send_ring.acquire()
+    view = send_ring.slot_view(slot)
+    view[:len(blob)] = blob
+    return send_ring.publish(slot, gen, len(blob))
+
+
+def persist_frame(fh, outcome_wires):
+    pickle.dump(outcome_wires, fh)

@@ -1,0 +1,255 @@
+"""Unit suite for the zero-copy mesh data plane's ring + codec layer
+(automerge_tpu/parallel/shm.py).
+
+The rings are the PR 19 tentpole: one bounded SPSC shared-memory ring
+per direction per shard, slots moving FREE -> PRODUCER_HELD ->
+CONSUMER_HELD -> FREE with a generation counter so a ref published
+before a crash reclaim can never alias a re-used slot. This suite pins
+the transport-layer contracts in isolation — no workers, no jax:
+
+- codec roundtrips (column batches, result frames with every outcome
+  shape the farm produces);
+- the slot lifecycle incl. backpressure (acquire waits, counts stalls,
+  then raises RingStall — never deadlocks) and producer backout;
+- generation staleness: accept() after a reclaim refuses the old ref;
+- reclaim semantics (full vs producer-held-only — the result ring must
+  preserve consumer-held slots backing live lazy patches);
+- segment hygiene: attach/untrack, close-unlinks-everything, and the
+  SlotRef int-cast pin (the PR 14 np.int64 JSONL bug class).
+"""
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from automerge_tpu.errors import DecodeError, DeviceFaultError
+from automerge_tpu.parallel import shm
+
+
+def _ring(tag="t", nslots=2, slot_bytes=4096):
+    return shm.ColumnRing.create(tag, nslots, slot_bytes)
+
+
+def _no_am_segments():
+    import glob
+    return glob.glob("/dev/shm/am-*") == []
+
+
+# --------------------------------------------------------------------- #
+# codecs
+
+
+def test_column_codec_roundtrip():
+    groups = [
+        (0, (b"alpha", b"", b"\x00\x01\x02")),
+        (17, ()),
+        (3, (b"z" * 1000,)),
+    ]
+    blob = shm.encode_columns(groups)
+    assert len(blob) == shm.measure_columns(groups)
+    assert shm.decode_columns(memoryview(blob)) == groups
+
+
+def test_column_codec_writes_into_mapped_slot():
+    ring = _ring()
+    try:
+        groups = [(5, (b"hello", b"world"))]
+        slot, gen = ring.acquire()
+        view = ring.slot_view(slot)
+        used = shm.encode_columns_into(view, groups)
+        del view
+        assert used == shm.measure_columns(groups)
+        ref = ring.publish(slot, gen, used)
+        got = ring.accept(ref)
+        assert shm.decode_columns(got) == groups
+        del got
+        ring.release(ref.slot)
+    finally:
+        ring.close()
+    assert _no_am_segments()
+
+
+def test_result_codec_roundtrip_all_outcome_shapes():
+    patches = pickle.dumps([{"objId": "_root", "action": "put"}])
+    wires = [
+        ("applied", None, None, (), False),
+        ("quarantined", pickle.dumps(ValueError("boom")), "decode",
+         ("deadbeef", b"\xff\x00raw"), False),
+        ("applied", None, None, (), True),  # device fallback
+    ]
+    frame = shm.encode_result(patches, wires)
+    (off, length), got = shm.decode_result(memoryview(frame))
+    assert memoryview(frame)[off:off + length] == patches
+    assert len(got) == len(wires)
+    for want, have in zip(wires, got):
+        status, blob, kind, offending, fallback = have
+        assert (status, blob, kind, fallback) == (
+            want[0], want[1], want[2], want[4])
+        assert tuple(offending) == tuple(want[3])
+        # str/bytes hash tags survive the flags byte
+        for w, h in zip(want[3], offending):
+            assert type(w) is type(h)
+
+
+def test_result_codec_common_case_is_compact():
+    # ("applied", None, None, (), False) must stay single-digit bytes —
+    # the result frame is per-doc, so bloat here scales with the batch
+    frame = shm.encode_result(b"", [("applied", None, None, (), False)])
+    assert len(frame) <= 8 + 4 + 1 + 4 + len(b"applied") + 4
+
+
+# --------------------------------------------------------------------- #
+# slot lifecycle + backpressure
+
+
+def test_slot_lifecycle_and_capacity_stall():
+    ring = _ring(nslots=2)
+    try:
+        refs = []
+        for i in range(2):
+            slot, gen = ring.acquire(timeout=0.05)
+            view = ring.slot_view(slot)
+            view[:1] = bytes([i])
+            del view
+            refs.append(ring.publish(slot, gen, 1))
+        assert ring.slots_in_use() == 2
+        stalls_before = ring.stalls
+        with pytest.raises(shm.RingStall):
+            ring.acquire(timeout=0.05)
+        assert ring.stalls == stalls_before + 1
+        # RingStall is a classifiable DeviceFaultError, not a bare raise
+        assert isinstance(shm.RingStall("x"), DeviceFaultError)
+        # consumer frees one slot -> producer unblocks
+        v = ring.accept(refs[0])
+        assert bytes(v) == b"\x00"
+        del v
+        ring.release(refs[0].slot)
+        slot, gen = ring.acquire(timeout=0.05)
+        assert slot == refs[0].slot
+        ring.abandon(slot)  # producer backout: straight to FREE
+        assert ring.slots_in_use() == 1
+    finally:
+        ring.close()
+    assert _no_am_segments()
+
+
+def test_accept_refuses_stale_generation_after_reclaim():
+    ring = _ring()
+    try:
+        slot, gen = ring.acquire()
+        ref = ring.publish(slot, gen, 0)
+        assert ring.reclaim() == 1  # "crash": the ref is now stale
+        slot2, gen2 = ring.acquire()
+        assert slot2 == slot and gen2 == gen + 1
+        ring.publish(slot2, gen2, 0)
+        with pytest.raises(DeviceFaultError):
+            ring.accept(ref)
+        # the re-published current ref still accepts fine
+        v = ring.accept(shm.SlotRef(slot2, gen2, 0))
+        del v
+        ring.release(slot2)
+    finally:
+        ring.close()
+    assert _no_am_segments()
+
+
+def test_accept_refuses_length_mismatch_and_bad_slot():
+    ring = _ring()
+    try:
+        slot, gen = ring.acquire()
+        ring.publish(slot, gen, 8)
+        with pytest.raises(DecodeError):
+            ring.accept(shm.SlotRef(slot, gen, 9))
+        with pytest.raises(DecodeError):
+            ring.accept(shm.SlotRef(99, 1, 0))
+    finally:
+        ring.close()
+
+
+def test_reclaim_preserves_consumer_held_when_asked():
+    ring = _ring(nslots=3)
+    try:
+        # slot A: consumer-held (a live lazy patch), slot B: producer-held
+        # (the dead worker was mid-write), slot C: free
+        sa, ga = ring.acquire()
+        va = ring.accept(ring.publish(sa, ga, 0))
+        va.release()  # drops the VIEW only; the slot stays CONSUMER_HELD
+        sb, _gb = ring.acquire()
+        assert ring.slots_in_use() == 2
+        # the result-ring reclaim shape: only the dead producer's slot
+        assert ring.reclaim(held_by_producer_only=True) == 1
+        assert ring.slots_in_use() == 1
+        # the send-ring reclaim shape frees everything
+        assert ring.reclaim() == 1
+        assert ring.slots_in_use() == 0
+        assert sb is not None
+    finally:
+        ring.close()
+    assert _no_am_segments()
+
+
+# --------------------------------------------------------------------- #
+# segment hygiene + control-frame pins
+
+
+def test_attach_maps_same_bytes_and_owner_unlinks():
+    ring = _ring()
+    peer = shm.attach_ring(ring.name)
+    try:
+        slot, gen = ring.acquire()
+        view = ring.slot_view(slot)
+        view[:5] = b"cross"
+        del view
+        ref = ring.publish(slot, gen, 5)
+        got = peer.accept(ref)
+        assert bytes(got) == b"cross"
+        del got
+        peer.release(ref.slot)
+    finally:
+        peer.close()       # attacher: close only, never unlink
+        assert not _no_am_segments()
+        ring.close()       # owner: close + unlink
+    assert _no_am_segments()
+
+
+def test_attach_rejects_non_ring_segment():
+    from multiprocessing import shared_memory
+    seg = shared_memory.SharedMemory(create=True, size=4096)
+    try:
+        with pytest.raises(DecodeError):
+            shm.attach_ring(seg.name)
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def test_ring_sizes_env_knobs(monkeypatch):
+    monkeypatch.setenv("AM_MESH_SHM_SLOTS", "5")
+    monkeypatch.setenv("AM_MESH_SHM_SLOT_BYTES", "8192")
+    assert shm.ring_sizes() == (5, 8192)
+    monkeypatch.setenv("AM_MESH_SHM_SLOTS", "1")      # floor: 2
+    monkeypatch.setenv("AM_MESH_SHM_SLOT_BYTES", "7")  # floor: 4096
+    assert shm.ring_sizes() == (2, 4096)
+
+
+def test_slotref_is_plain_int_and_pickles():
+    """The PR 14 satellite pin: ring offsets/lengths/generations reach
+    flight events and JSONL dumps, so SlotRef fields must be plain int
+    at construction even when fed np.int64 — ``json.dumps`` must never
+    see a numpy scalar."""
+    ref = shm.SlotRef(np.int64(3), np.int64(7), np.int64(4096))
+    assert type(ref.slot) is int
+    assert type(ref.generation) is int
+    assert type(ref.nbytes) is int
+    json.dumps({"slot": ref.slot, "generation": ref.generation,
+                "nbytes": ref.nbytes})
+    clone = pickle.loads(pickle.dumps(ref))
+    assert (clone.slot, clone.generation, clone.nbytes) == (3, 7, 4096)
+    assert type(clone.slot) is int
+
+
+def test_shm_available_probe_is_cached_and_true_here():
+    assert shm.shm_available() is True
+    assert shm.shm_available() is True  # cached, no re-probe crash
+    assert _no_am_segments()

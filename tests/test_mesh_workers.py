@@ -9,6 +9,13 @@ land in quarantine under ``WorkerCrashError`` (kind "worker_crash"),
 and after ``release_quarantine`` + re-delivery the recovered docs
 converge to the oracle too (the respawned worker was re-hydrated from
 the controller's delivery log).
+
+The PR 19 additions pin the zero-copy shm data plane end-to-end:
+transport patch parity (shm byte-identical to the pickle oracle and the
+inline farm, including a mid-delivery migration), SIGKILL while slots
+are held (generation-counter reclaim, remap metering, convergence), the
+payload/control pipe-accounting split, and zero leaked ``/dev/shm``
+segments after clean shutdown AND after crash-respawn cycles.
 """
 import json
 import multiprocessing
@@ -289,6 +296,191 @@ def test_worker_exemplar_resolves_to_controller_span():
             assert hist.exemplar_for(0.99) == span.dispatch_id
         finally:
             mesh.close()
+    assert multiprocessing.active_children() == []
+
+
+def _shm_segments():
+    import glob
+    return glob.glob("/dev/shm/am-*")
+
+
+def test_shm_patch_parity_with_pickle_oracle_and_inline():
+    """PR 19 acceptance: shm-transport patches are byte-for-byte the
+    pickle oracle's (and the inline farm's), including a mid-delivery
+    migration — the rings change how bytes move, never what they say."""
+    deliveries = _rounds(seed=7)
+    inline = _drive_inline(deliveries)
+
+    def drive(transport):
+        mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                        mesh_backend="process", mesh_transport=transport)
+        try:
+            assert mesh.transport == transport
+            for r, buffers in enumerate(_rounds(seed=7)):
+                mesh.apply_changes(
+                    [list(buffers) for _ in range(NUM_DOCS)],
+                    isolation="doc",
+                )
+                if r == 1:
+                    d = next(x for x in range(NUM_DOCS)
+                             if mesh.shard_of(x) == 0)
+                    mesh.migrate_doc(d, 1)
+                    mesh.audit()
+            return _final_patches(mesh)
+        finally:
+            mesh.close()
+
+    shm_patches = drive("shm")
+    assert shm_patches == drive("pickle")
+    assert shm_patches == inline
+    assert _shm_segments() == []
+    assert multiprocessing.active_children() == []
+    assert deliveries  # the workload generator produced real rounds
+
+
+def test_worker_sigkill_while_holding_slot_reclaims_and_remaps():
+    """The PR 19 satellite: SIGKILL a worker mid-apply under the shm
+    transport — the dead worker's held ring slots reclaim via the
+    generation counter (no deadlock on later acquires), the in-flight
+    docs quarantine, the respawned worker remaps the SAME segments
+    (``mesh.shm.remaps`` + a ``mesh.shm.remap`` flight event with plain
+    int fields — the PR 14 np.int64 pin), and re-delivery converges to
+    the inline oracle."""
+    from automerge_tpu.obs.flight import enabled_flight
+    from automerge_tpu.obs.metrics import enabled_metrics
+
+    deliveries = _rounds()
+    oracle = _drive_inline(deliveries)
+    with enabled_metrics() as reg, enabled_flight() as rec:
+        reg.reset()
+        rec.clear()
+        mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                        mesh_backend="process", mesh_transport="shm")
+        try:
+            assert mesh.transport == "shm"
+            assert len(_shm_segments()) == 2 * NUM_SHARDS
+            assert reg.as_dict()["mesh.shm.segments"]["value"] \
+                == 2 * NUM_SHARDS
+            for r, buffers in enumerate(deliveries):
+                per_doc = [list(buffers) for _ in range(NUM_DOCS)]
+                if r == CRASH_ROUND:
+                    mesh.inject_worker_fault(1, when="next_apply")
+                res = mesh.apply_changes(per_doc, isolation="doc")
+                if r != CRASH_ROUND:
+                    assert not res.quarantined
+                    continue
+                q = res.quarantined
+                assert sorted(q) == sorted(
+                    d for d in range(NUM_DOCS) if mesh.shard_of(d) == 1
+                )
+                for outcome in q.values():
+                    assert isinstance(outcome.error, WorkerCrashError)
+                    assert error_kind(outcome.error) == "worker_crash"
+                # the crash-reclaim freed the dead worker's send-ring
+                # slots — nothing held, nothing deadlocked
+                send_ring, _result_ring = mesh._rings[1]
+                assert send_ring.slots_in_use() == 0
+                assert sorted(mesh.release_quarantine()) == sorted(q)
+                redo = [per_doc[d] if d in q else []
+                        for d in range(NUM_DOCS)]
+                redo_res = mesh.apply_changes(redo, isolation="doc")
+                assert all(o.status == "applied"
+                           for o in redo_res.outcomes)
+            assert _final_patches(mesh) == oracle
+            snap = reg.as_dict()
+            assert snap["mesh.shm.remaps"]["value"] >= 1
+            remaps = [e for e in rec.snapshot()
+                      if e["event"] == "mesh.shm.remap"]
+            assert remaps, "respawn recorded no mesh.shm.remap event"
+            fields = remaps[-1]["fields"]
+            assert fields["shard"] == 1
+            for key in ("shard", "epoch", "freed_slots"):
+                assert type(fields[key]) is int, (key, fields[key])
+            json.dumps(fields)  # JSONL-safe: no np.int64 leaks
+        finally:
+            mesh.close()
+        # clean shutdown unlinked every segment, gauge agrees
+        assert reg.as_dict()["mesh.shm.segments"]["value"] == 0
+    assert _shm_segments() == []
+    assert multiprocessing.active_children() == []
+
+
+def test_pipe_payload_control_split_by_transport():
+    """The PR 19 satellite: ``mesh.pipe.<s>.serialize_ms`` aggregate
+    gets a payload/control breakdown. Under the pickle oracle the apply
+    batches and result frames classify as payload; under shm the payload
+    legs sit at exactly zero — every remaining pipe frame is control."""
+    from automerge_tpu.obs.metrics import enabled_metrics
+
+    deliveries = _rounds(rounds=2)
+
+    def split(transport):
+        with enabled_metrics() as reg:
+            reg.reset()
+            mesh = MeshFarm(NUM_DOCS, num_shards=NUM_SHARDS, capacity=64,
+                            mesh_backend="process",
+                            mesh_transport=transport)
+            try:
+                for buffers in deliveries:
+                    mesh.apply_changes(
+                        [list(buffers) for _ in range(NUM_DOCS)],
+                        isolation="doc",
+                    )
+                snap = reg.as_dict()
+            finally:
+                mesh.close()
+
+        def total(suffix, field):
+            return sum(
+                snap.get(f"mesh.pipe.{s}.{suffix}", {}).get(field, 0)
+                for s in range(NUM_SHARDS)
+            )
+
+        return {
+            "payload_frames": total("payload_ms", "count"),
+            "payload_bytes": total("payload_bytes", "value"),
+            "control_frames": total("control_ms", "count"),
+            "control_bytes": total("control_bytes", "value"),
+        }
+
+    p = split("pickle")
+    assert p["payload_frames"] > 0 and p["payload_bytes"] > 0
+    assert p["control_frames"] > 0 and p["control_bytes"] > 0
+    s = split("shm")
+    assert s["payload_frames"] == 0 and s["payload_bytes"] == 0
+    assert s["control_frames"] > 0 and s["control_bytes"] > 0
+    assert _shm_segments() == []
+    assert multiprocessing.active_children() == []
+
+
+def test_mesh_transport_resolution():
+    """``mesh_transport=None`` reads AM_MESH_TRANSPORT; non-process
+    backends always resolve to pickle (there are no rings to map); an
+    unknown value is an API-usage error."""
+    old = os.environ.get("AM_MESH_TRANSPORT")
+    os.environ["AM_MESH_TRANSPORT"] = "pickle"
+    try:
+        mesh = MeshFarm(4, num_shards=NUM_SHARDS, capacity=16,
+                        mesh_backend="process")
+        try:
+            assert mesh.transport == "pickle"
+            assert _shm_segments() == []  # pickle mode maps no rings
+        finally:
+            mesh.close()
+    finally:
+        if old is None:
+            os.environ.pop("AM_MESH_TRANSPORT", None)
+        else:
+            os.environ["AM_MESH_TRANSPORT"] = old
+    inline = MeshFarm(4, num_shards=NUM_SHARDS, capacity=16,
+                      mesh_backend="inline", mesh_transport="shm")
+    try:
+        assert inline.transport == "pickle"
+    finally:
+        inline.close()
+    with pytest.raises(ValueError):
+        MeshFarm(4, num_shards=NUM_SHARDS, capacity=16,
+                 mesh_backend="inline", mesh_transport="bogus")
     assert multiprocessing.active_children() == []
 
 

@@ -48,7 +48,9 @@ def test_repo_suppressions_are_justified():
     the scalar-oracle gate/transcode loops AM107 marks in farm.py,
     the single real-time clock default AM402 site, the mesh
     worker's record-locally/ship-deltas registry and flight shipping-
-    buffer sites AM502/AM305 mark in parallel/workers.py, and the store
+    buffer sites AM502/AM305 mark in parallel/workers.py, the pickle
+    parity-oracle send path AM504 marks in parallel/workers.py (the one
+    blessed pickle on the shm transport's data plane), and the store
     tier's own write primitives — the atomic writer's tmp-file handle
     and the WAL's checksummed appender — which AM601 marks in
     store/atomic.py and store/wal.py, and the pad-to-pow2-bucket
@@ -62,7 +64,7 @@ def test_repo_suppressions_are_justified():
     assert suppressed, "expected in-tree justified suppressions"
     assert {f.rule_id for f in suppressed} == {
         "AM103", "AM105", "AM106", "AM107", "AM305", "AM401", "AM402",
-        "AM502", "AM601", "AM701",
+        "AM502", "AM504", "AM601", "AM701",
     }
 
 
